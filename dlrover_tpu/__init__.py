@@ -1,0 +1,23 @@
+"""dlrover_tpu: a TPU-native elastic training operations framework.
+
+A from-scratch rebuild of the capabilities of DLRover (reference:
+/root/reference, Mu-L/dlrover) designed for JAX/XLA on TPU slices:
+
+- Job master (per-job control plane): rendezvous, node lifecycle, dynamic
+  data sharding, diagnosis, auto-scaling.
+- Elastic agent (per-host control plane): supervises JAX worker processes,
+  injects ``jax.distributed`` coordination env, restarts/relaunches on
+  failure, hosts the async flash-checkpoint saver.
+- Flash checkpoint: JAX pytrees -> host shared memory in O(100ms), async
+  persist to storage, memory-first resume, resharding restore across mesh
+  changes.
+- Node/network check: MXU matmul + ICI/DCN collective probes with pairwise
+  fault isolation and straggler detection.
+- Training stack: models/, ops/ (Pallas kernels), parallel/ (dp/fsdp/tp/
+  pp/sp/ep shardings over ``jax.sharding.Mesh``).
+
+The control plane mirrors the reference's layering (SURVEY.md section 1) but
+every data-plane mechanism is JAX-idiomatic rather than a port.
+"""
+
+__version__ = "0.1.0"

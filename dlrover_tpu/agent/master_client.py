@@ -1,0 +1,403 @@
+"""Typed client of the master's get/report protocol.
+
+Parity: reference dlrover/python/elastic_agent/master_client.py:51-778
+(MasterClient with gRPC/HTTP transports, retry wrapper, singleton).
+"""
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.comm import Message
+from dlrover_tpu.common.constants import JobConstant, NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.rpc.transport import build_master_stub
+
+
+def retry_rpc(func):
+    def wrapper(self, *args, **kwargs):
+        retry = max(
+            kwargs.pop("retry", JobConstant.MASTER_CLIENT_DEFAULT_RETRY), 1
+        )
+        err = None
+        for i in range(retry):
+            if i > 0:
+                time.sleep(min(2 ** (i - 1), 8))
+            try:
+                return func(self, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — transport errors vary
+                err = e
+        logger.warning("RPC %s failed after %d tries: %s", func.__name__, retry, err)
+        raise err
+
+    return wrapper
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int = 0,
+        node_type: str = "worker",
+        kind: str = "grpc",
+        timeout: float = JobConstant.MASTER_CLIENT_TIMEOUT_DEFAULT,
+    ):
+        self._addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._stub = build_master_stub(master_addr, kind=kind, timeout=timeout)
+
+    # ---- plumbing ----------------------------------------------------------
+
+    def _get(self, request: comm.BaseRequest, timeout: Optional[float] = None):
+        msg = Message(
+            node_id=self._node_id,
+            node_type=self._node_type,
+            data=request.serialize(),
+        )
+        resp = self._stub.get(msg, timeout=timeout)
+        return comm.BaseResponse.deserialize(resp.data)
+
+    def _report(self, request: comm.BaseRequest, timeout: Optional[float] = None):
+        msg = Message(
+            node_id=self._node_id,
+            node_type=self._node_type,
+            data=request.serialize(),
+        )
+        resp = self._stub.report(msg, timeout=timeout)
+        return comm.BaseResponse.deserialize(resp.data)
+
+    def wait_master_ready(self, timeout: float = 120.0) -> bool:
+        return self._stub.wait_ready(timeout)
+
+    def close(self):
+        self._stub.close()
+
+    # ---- rendezvous --------------------------------------------------------
+
+    @retry_rpc
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str,
+        node_unit: int = 1,
+        node_ip: str = "",
+    ) -> int:
+        resp = self._report(
+            comm.JoinRendezvousRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_unit=node_unit,
+                node_ip=node_ip,
+            )
+        )
+        return getattr(resp, "round", 0)
+
+    @retry_rpc
+    def get_comm_world(self, rdzv_name: str, node_rank: int):
+        resp = self._get(
+            comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
+        )
+        return resp.round, resp.group, resp.world
+
+    @retry_rpc
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        resp = self._get(comm.NumNodesWaitingRequest(rdzv_name=rdzv_name))
+        return resp.waiting_num
+
+    # ---- network check -----------------------------------------------------
+
+    @retry_rpc
+    def report_network_check_result(
+        self, node_rank: int, succeeded: bool, elapsed: float
+    ):
+        return self._report(
+            comm.NetworkCheckResultReport(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                succeeded=succeeded,
+                result=elapsed,
+            )
+        )
+
+    @retry_rpc
+    def check_fault_node(self) -> List[int]:
+        resp = self._get(comm.FaultNodeRequest())
+        return resp.fault_nodes
+
+    @retry_rpc
+    def check_straggler(self) -> List[int]:
+        resp = self._get(comm.StragglerRequest())
+        return resp.stragglers
+
+    # ---- heartbeat / events ------------------------------------------------
+
+    def report_heartbeat(self, timestamp: Optional[float] = None):
+        resp = self._report(
+            comm.HeartbeatReport(
+                node_id=self._node_id, timestamp=timestamp or time.time()
+            ),
+        )
+        return getattr(resp, "actions", [])
+
+    @retry_rpc
+    def report_failure(
+        self,
+        error_data: str,
+        node_rank: int = 0,
+        restart_count: int = 0,
+        exit_code: int = 0,
+        level: str = "process",
+    ):
+        return self._report(
+            comm.NodeFailureReport(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                error_data=error_data,
+                restart_count=restart_count,
+                exit_code=exit_code,
+                level=level,
+            )
+        )
+
+    @retry_rpc
+    def report_succeeded(self):
+        return self._report(
+            comm.SucceededRequest(
+                node_id=self._node_id, node_type=self._node_type
+            )
+        )
+
+    @retry_rpc
+    def report_node_event(self, event_type: str, reason: str = "", message: str = ""):
+        return self._report(
+            comm.NodeEventReport(
+                node_id=self._node_id,
+                event_type=event_type,
+                reason=reason,
+                message=message,
+            )
+        )
+
+    def report_diagnosis_data(self, data_type: str, payload: Dict):
+        try:
+            return self._report(
+                comm.DiagnosisDataReport(
+                    node_id=self._node_id,
+                    data_type=data_type,
+                    payload=payload,
+                    timestamp=time.time(),
+                )
+            )
+        except Exception:
+            logger.debug("diagnosis data report failed", exc_info=True)
+
+    # ---- perf / resources --------------------------------------------------
+
+    def report_used_resource(
+        self, cpu_percent: float, memory_mb: float, tpu_duty: float = 0.0,
+        hbm_used_mb: float = 0.0,
+    ):
+        try:
+            return self._report(
+                comm.ResourceStats(
+                    node_id=self._node_id,
+                    cpu_percent=cpu_percent,
+                    memory_mb=memory_mb,
+                    tpu_duty_cycle=tpu_duty,
+                    hbm_used_mb=hbm_used_mb,
+                )
+            )
+        except Exception:
+            logger.debug("resource report failed", exc_info=True)
+
+    def report_global_step(self, step: int, elapsed_train_secs: float = 0.0):
+        try:
+            return self._report(
+                comm.GlobalStepReport(
+                    node_id=self._node_id,
+                    step=step,
+                    timestamp=time.time(),
+                    elapsed_train_secs=elapsed_train_secs,
+                )
+            )
+        except Exception:
+            logger.debug("global step report failed", exc_info=True)
+
+    def report_goodput_phase(self, phase: str, start: float, end: float):
+        try:
+            return self._report(
+                comm.GoodputPhaseReport(
+                    node_id=self._node_id, phase=phase, start=start, end=end
+                )
+            )
+        except Exception:
+            logger.debug("goodput phase report failed", exc_info=True)
+
+    # ---- kv store ----------------------------------------------------------
+
+    @retry_rpc
+    def kv_store_set(self, key: str, value: bytes):
+        return self._report(comm.KVStoreSetRequest(key=key, value=value))
+
+    @retry_rpc
+    def kv_store_get(self, key: str) -> bytes:
+        resp = self._get(comm.KVStoreGetRequest(key=key))
+        return resp.value
+
+    def kv_store_add(self, key: str, delta: int = 1) -> int:
+        # Deliberately NOT retried: add is a non-idempotent mutation and a
+        # lost response must not double-apply the increment. Callers that
+        # need at-least-once semantics should use kv_store_set with a
+        # caller-chosen unique key instead.
+        resp = self._get(comm.KVStoreAddRequest(key=key, delta=delta))
+        return resp.value
+
+    @retry_rpc
+    def kv_store_multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        resp = self._get(comm.KVStoreMultiGetRequest(keys=keys))
+        return resp.values
+
+    # ---- sync --------------------------------------------------------------
+
+    @retry_rpc
+    def join_sync(self, sync_name: str, node_rank: int):
+        return self._report(
+            comm.SyncJoinRequest(
+                sync_name=sync_name, node_id=self._node_id, node_rank=node_rank
+            )
+        )
+
+    @retry_rpc
+    def sync_finished(self, sync_name: str):
+        return self._report(comm.SyncFinishRequest(sync_name=sync_name))
+
+    @retry_rpc
+    def sync_barrier(self, sync_name: str) -> bool:
+        resp = self._get(comm.SyncQueryRequest(sync_name=sync_name))
+        return resp.done
+
+    # ---- data sharding -----------------------------------------------------
+
+    @retry_rpc
+    def report_dataset_shard_params(self, params: comm.DatasetShardParams):
+        return self._report(params)
+
+    @retry_rpc
+    def get_task(self, dataset_name: str) -> comm.ShardTask:
+        return self._get(
+            comm.TaskRequest(dataset_name=dataset_name, node_id=self._node_id)
+        )
+
+    @retry_rpc
+    def report_task_done(self, dataset_name: str, task_id: int):
+        return self._report(
+            comm.TaskDoneReport(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                node_id=self._node_id,
+            )
+        )
+
+    @retry_rpc
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._get(comm.ShardCheckpointRequest(dataset_name=dataset_name))
+        return resp.checkpoint
+
+    @retry_rpc
+    def restore_shard_checkpoint(self, dataset_name: str, checkpoint: str):
+        return self._report(
+            comm.ShardCheckpointRestoreRequest(
+                dataset_name=dataset_name, checkpoint=checkpoint
+            )
+        )
+
+    # ---- checkpoint --------------------------------------------------------
+
+    @retry_rpc
+    def report_ckpt_step(self, step: int, committed: bool = False):
+        return self._report(
+            comm.CkptStepReport(
+                node_id=self._node_id, step=step, committed=committed
+            )
+        )
+
+    @retry_rpc
+    def get_ckpt_latest_step(self) -> int:
+        resp = self._get(comm.CkptLatestStepRequest())
+        return resp.step
+
+    # ---- pre-check / config ------------------------------------------------
+
+    @retry_rpc
+    def get_pre_check_result(self) -> str:
+        resp = self._get(comm.PreCheckRequest(node_id=self._node_id))
+        return resp.status
+
+    @retry_rpc
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        resp = self._get(comm.ElasticRunConfigRequest())
+        return resp.configs
+
+    @retry_rpc
+    def get_parallel_config(self) -> comm.ParallelConfig:
+        return self._get(comm.ParallelConfigRequest(node_id=self._node_id))
+
+    @retry_rpc
+    def get_job_detail(self) -> comm.JobDetailResponse:
+        return self._get(comm.JobDetailRequest())
+
+    # ---- cluster version (PS parity) ---------------------------------------
+
+    @retry_rpc
+    def get_cluster_version(self, version_type: str, task_type: str, task_id: int):
+        resp = self._get(
+            comm.ClusterVersionRequest(
+                task_type=task_type, task_id=task_id, version_type=version_type
+            )
+        )
+        return resp.version
+
+    @retry_rpc
+    def update_cluster_version(
+        self, version_type: str, version: int, task_type: str, task_id: int
+    ):
+        return self._report(
+            comm.ClusterVersionReport(
+                task_type=task_type,
+                task_id=task_id,
+                version_type=version_type,
+                version=version,
+            )
+        )
+
+    # ---- singleton ---------------------------------------------------------
+
+    @classmethod
+    def singleton_instance(cls, *args, **kwargs) -> "MasterClient":
+        with cls._lock:
+            if cls._instance is None:
+                if not args and "master_addr" not in kwargs:
+                    addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+                    if not addr:
+                        raise RuntimeError(
+                            f"{NodeEnv.MASTER_ADDR} unset and no addr given"
+                        )
+                    node_id = int(os.getenv(NodeEnv.NODE_ID, 0))
+                    cls._instance = cls(addr, node_id=node_id)
+                else:
+                    cls._instance = cls(*args, **kwargs)
+            return cls._instance
+
+    @classmethod
+    def reset_singleton(cls):
+        with cls._lock:
+            cls._instance = None

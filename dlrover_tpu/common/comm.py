@@ -1,0 +1,450 @@
+"""Control-plane message vocabulary.
+
+The master<->agent protocol is two RPCs — ``get(request) -> response`` and
+``report(request) -> ack`` — carrying typed dataclasses (reference:
+dlrover/python/proto/elastic_training.proto:26-29 and
+dlrover/python/common/comm.py:105-560). Dataclasses here are re-designed
+around JAX's coordination model: rendezvous produces the
+(coordinator_address, num_processes, process_id) triple plus a mesh-shape
+hint instead of a torch process-group world.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.serialize import PickleSerializable
+
+
+@dataclass
+class Message(PickleSerializable):
+    """Envelope for both directions of the get/report protocol."""
+
+    node_id: int = -1
+    node_type: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class BaseRequest(PickleSerializable):
+    pass
+
+
+@dataclass
+class BaseResponse(PickleSerializable):
+    success: bool = True
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# Rendezvous
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class JoinRendezvousRequest(BaseRequest):
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1  # JAX processes per host (usually 1 on TPU)
+    rdzv_name: str = ""
+    node_unit: int = 1  # node count must be a multiple of this
+    node_ip: str = ""
+
+
+@dataclass
+class JoinRendezvousResponse(BaseResponse):
+    round: int = 0
+
+
+@dataclass
+class CommWorldRequest(BaseRequest):
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(BaseResponse):
+    """A completed rendezvous round.
+
+    ``world`` maps node_rank -> local_world_size for every participant;
+    ``group`` partitions nodes during network check (reference
+    rdzv_manager.py:_get_comm_world). The agent derives
+    ``jax.distributed.initialize`` args from it.
+    """
+
+    round: int = 0
+    group: int = 0
+    world: Dict[int, int] = field(default_factory=dict)
+    coordinator_rank: int = -1  # node chosen to host the JAX coordinator
+
+
+@dataclass
+class RendezvousState(BaseResponse):
+    waiting_num: int = 0
+    completed: bool = False
+    round: int = 0
+
+
+@dataclass
+class NumNodesWaitingRequest(BaseRequest):
+    rdzv_name: str = ""
+
+
+@dataclass
+class NumNodesWaitingResponse(BaseResponse):
+    waiting_num: int = 0
+
+
+# --------------------------------------------------------------------------
+# Node / network check
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NetworkReadyRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class NetworkCheckResultReport(BaseRequest):
+    node_id: int = 0
+    node_rank: int = 0
+    result: float = 0.0  # elapsed seconds of the probe; inf on failure
+    succeeded: bool = True
+
+
+@dataclass
+class FaultNodeRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class FaultNodeResponse(BaseResponse):
+    fault_nodes: List[int] = field(default_factory=list)
+
+
+@dataclass
+class StragglerRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class StragglerResponse(BaseResponse):
+    stragglers: List[int] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# Heartbeat & diagnosis
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class HeartbeatReport(BaseRequest):
+    node_id: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class HeartbeatResponse(BaseResponse):
+    # Serialized DiagnosisAction instances for the agent to execute.
+    actions: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class DiagnosisDataReport(BaseRequest):
+    """Generic diagnosis payload (metrics scrape, log tail, chip events)."""
+
+    node_id: int = 0
+    data_type: str = ""
+    payload: Dict[str, Any] = field(default_factory=dict)
+    timestamp: float = 0.0
+
+
+@dataclass
+class NodeFailureReport(BaseRequest):
+    node_id: int = 0
+    node_rank: int = 0
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+    exit_code: int = 0
+
+
+@dataclass
+class SucceededRequest(BaseRequest):
+    node_id: int = 0
+    node_type: str = ""
+
+
+@dataclass
+class NodeEventReport(BaseRequest):
+    node_id: int = 0
+    event_type: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+# --------------------------------------------------------------------------
+# Resources & performance
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceStats(BaseRequest):
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    tpu_duty_cycle: float = 0.0  # chip busy-%
+    hbm_used_mb: float = 0.0
+
+
+@dataclass
+class GlobalStepReport(BaseRequest):
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = 0.0
+    elapsed_train_secs: float = 0.0  # productive train time since last report
+
+
+@dataclass
+class GoodputPhaseReport(BaseRequest):
+    """Attributes a span of wall time to a goodput phase (train/ckpt/
+    restart/rendezvous), the basis of the goodput metric."""
+
+    node_id: int = 0
+    phase: str = ""
+    start: float = 0.0
+    end: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# KV-store (rendezvous store / barriers for workers)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KVStoreSetRequest(BaseRequest):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KVStoreGetRequest(BaseRequest):
+    key: str = ""
+
+
+@dataclass
+class KVStoreGetResponse(BaseResponse):
+    value: bytes = b""
+
+
+@dataclass
+class KVStoreAddRequest(BaseRequest):
+    key: str = ""
+    delta: int = 1
+
+
+@dataclass
+class KVStoreAddResponse(BaseResponse):
+    value: int = 0
+
+
+@dataclass
+class KVStoreMultiGetRequest(BaseRequest):
+    keys: List[str] = field(default_factory=list)
+
+
+@dataclass
+class KVStoreMultiGetResponse(BaseResponse):
+    values: Dict[str, bytes] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# Sync service (named barriers)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SyncJoinRequest(BaseRequest):
+    sync_name: str = ""
+    node_id: int = 0
+    node_rank: int = 0
+
+
+@dataclass
+class SyncFinishRequest(BaseRequest):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncQueryRequest(BaseRequest):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncQueryResponse(BaseResponse):
+    done: bool = False
+
+
+# --------------------------------------------------------------------------
+# Dynamic data sharding
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DatasetShardParams(BaseRequest):
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0  # records per task/shard
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "text"
+    task_type: str = "training"
+
+
+@dataclass
+class TaskRequest(BaseRequest):
+    dataset_name: str = ""
+    node_id: int = 0
+
+
+@dataclass
+class ShardTask(BaseResponse):
+    task_id: int = -1
+    task_type: str = "none"
+    dataset_name: str = ""
+    start: int = 0
+    end: int = 0
+    epoch: int = 0
+    # Explicit (possibly shuffled) record indices for text datasets; None
+    # means the contiguous [start, end) range.
+    record_indices: Optional[List[int]] = None
+
+
+@dataclass
+class TaskDoneReport(BaseRequest):
+    dataset_name: str = ""
+    task_id: int = -1
+    node_id: int = 0
+
+
+@dataclass
+class ShardCheckpointRequest(BaseRequest):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpointResponse(BaseResponse):
+    checkpoint: str = ""  # JSON blob of undone shards
+
+
+@dataclass
+class ShardCheckpointRestoreRequest(BaseRequest):
+    dataset_name: str = ""
+    checkpoint: str = ""
+
+
+# --------------------------------------------------------------------------
+# Checkpoint coordination
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CkptStepReport(BaseRequest):
+    node_id: int = 0
+    step: int = 0
+    committed: bool = False
+
+
+@dataclass
+class CkptLatestStepRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class CkptLatestStepResponse(BaseResponse):
+    step: int = -1
+
+
+# --------------------------------------------------------------------------
+# Pre-check, config, job control
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class PreCheckRequest(BaseRequest):
+    node_id: int = 0
+
+
+@dataclass
+class PreCheckResponse(BaseResponse):
+    status: str = "PASS"
+
+
+@dataclass
+class ParallelConfigRequest(BaseRequest):
+    node_id: int = 0
+
+
+@dataclass
+class ParallelConfig(BaseResponse):
+    """Master-suggested runtime knobs (reference ParallelConfig /
+    hyperparams/simple_strategy_generator.py), re-pointed at JAX knobs."""
+
+    micro_batch_size: int = 0
+    grad_accum_steps: int = 0
+    remat_policy: str = ""
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    version: int = 0
+
+
+@dataclass
+class ElasticRunConfigRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class ElasticRunConfigResponse(BaseResponse):
+    configs: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class JobDetailRequest(BaseRequest):
+    pass
+
+
+@dataclass
+class JobDetailResponse(BaseResponse):
+    job_name: str = ""
+    stage: str = ""
+    nodes: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# Cluster version tracking (PS-style elasticity parity; reference
+# master/elastic_training/elastic_ps.py)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterVersionRequest(BaseRequest):
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = ""
+
+
+@dataclass
+class ClusterVersionResponse(BaseResponse):
+    version: int = 0
+
+
+@dataclass
+class ClusterVersionReport(BaseRequest):
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = ""
+    version: int = 0
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,80 @@
+"""Environment-variable helpers for the master/agent/worker protocol."""
+
+import os
+import socket
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv, WorkerEnv
+
+
+def get_env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.getenv(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def get_env_str(name: str, default: str = "") -> str:
+    return os.getenv(name, default)
+
+
+def get_node_id() -> int:
+    return get_env_int(NodeEnv.NODE_ID, 0)
+
+
+def get_node_rank() -> int:
+    return get_env_int(NodeEnv.NODE_RANK, get_node_id())
+
+
+def get_node_num() -> int:
+    return get_env_int(NodeEnv.NODE_NUM, 1)
+
+
+def get_master_addr() -> str:
+    return get_env_str(NodeEnv.MASTER_ADDR, "")
+
+
+def get_hostname_ip():
+    hostname = socket.gethostname()
+    try:
+        ip = socket.gethostbyname(hostname)
+    except socket.gaierror:
+        ip = "127.0.0.1"
+    return hostname, ip
+
+
+def find_free_port(start: int = 0) -> int:
+    """Ask the OS for a free TCP port (bind to 0) or probe from ``start``."""
+    if start == 0:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+    for port in range(start, start + 1000):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(("", port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError("no free port found")
+
+
+def worker_env(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_rank: int = 0,
+    local_world_size: int = 1,
+    restart_count: int = 0,
+    rdzv_round: int = 0,
+) -> dict:
+    """Build the env block the agent injects into each JAX worker."""
+    return {
+        WorkerEnv.COORDINATOR_ADDRESS: coordinator,
+        WorkerEnv.NUM_PROCESSES: str(num_processes),
+        WorkerEnv.PROCESS_ID: str(process_id),
+        WorkerEnv.LOCAL_RANK: str(local_rank),
+        WorkerEnv.LOCAL_WORLD_SIZE: str(local_world_size),
+        WorkerEnv.RESTART_COUNT: str(restart_count),
+        WorkerEnv.RDZV_ROUND: str(rdzv_round),
+    }

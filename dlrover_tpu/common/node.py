@@ -1,0 +1,171 @@
+"""Node domain model held by the job master.
+
+Parity: reference dlrover/python/common/node.py:44-460 (Node, NodeResource,
+NodeGroupResource, NodeEvent). A "node" here is one TPU host (one JAX
+process slot) inside a slice, or a CPU worker in local mode.
+"""
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+
+
+@dataclass
+class NodeResource:
+    """Requested/used resources of one node."""
+
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    tpu_chips: int = 0
+    tpu_type: str = ""  # e.g. "v5litepod"
+    priority: str = ""
+
+    @classmethod
+    def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":
+        """Parse "cpu=4,memory=8192Mi,tpu=4" style strings."""
+        res = cls()
+        if not resource:
+            return res
+        for kv in resource.split(","):
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            k = k.strip().lower()
+            v = v.strip()
+            if k == "cpu":
+                res.cpu = float(v)
+            elif k == "memory":
+                res.memory_mb = float(v.rstrip("Mi").rstrip("mi"))
+            elif k in ("tpu", "tpu_chips"):
+                res.tpu_chips = int(v)
+        return res
+
+
+@dataclass
+class NodeGroupResource:
+    """Resource template for one role group (count x per-node resource)."""
+
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+
+class Node:
+    """Mutable per-node record tracked by the master's job manager."""
+
+    def __init__(
+        self,
+        node_type: str = NodeType.WORKER,
+        node_id: int = 0,
+        rank_index: Optional[int] = None,
+        name: str = "",
+        host_name: str = "",
+        host_ip: str = "",
+        status: str = NodeStatus.INITIAL,
+        config_resource: Optional[NodeResource] = None,
+        max_relaunch_count: int = 3,
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.host_name = host_name
+        self.host_ip = host_ip
+        self.status = status
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+        self.max_relaunch_count = max_relaunch_count
+
+        self.relaunch_count = 0
+        self.relaunchable = True
+        self.is_released = False
+        self.exit_reason = ""
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+        self.restart_training = False
+        self.critical = False
+        self.migrated = False
+        self.paral_config_version = -1
+        self.group: Optional[int] = None  # node group for grouped relaunch
+        self.reported_status: str = ""
+
+    # ---- status transitions -------------------------------------------------
+
+    def update_status(self, status: str) -> bool:
+        from dlrover_tpu.master.node.status_flow import NodeStateFlow
+
+        allowed = NodeStateFlow.transition_allowed(self.status, status)
+        if allowed:
+            if (
+                status == NodeStatus.RUNNING
+                and self.status != NodeStatus.RUNNING
+            ):
+                self.start_time = time.time()
+            if status in NodeStatus.end_states():
+                self.finish_time = time.time()
+            self.status = status
+        return allowed
+
+    def is_end(self) -> bool:
+        return self.status in NodeStatus.end_states()
+
+    def is_unrecoverable_failure(self) -> str:
+        """Return a non-empty reason if this node must not be relaunched."""
+        if not self.relaunchable:
+            return "node not relaunchable"
+        if self.relaunch_count >= self.max_relaunch_count:
+            return (
+                f"relaunch count {self.relaunch_count} >= "
+                f"max {self.max_relaunch_count}"
+            )
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return "fatal software error"
+        return ""
+
+    def inc_relaunch_count(self):
+        self.relaunch_count += 1
+
+    def update_from_resource_stats(self, cpu: float, memory_mb: float):
+        self.used_resource.cpu = cpu
+        self.used_resource.memory_mb = memory_mb
+
+    def get_relaunch_node(self, new_id: int) -> "Node":
+        """Build the replacement node record after a relaunch decision."""
+        new_node = copy.copy(self)
+        new_node.id = new_id
+        new_node.name = f"{self.type}-{new_id}"
+        new_node.status = NodeStatus.INITIAL
+        new_node.start_time = None
+        new_node.finish_time = None
+        new_node.is_released = False
+        new_node.exit_reason = ""
+        new_node.relaunch_count = self.relaunch_count + 1
+        new_node.used_resource = NodeResource()
+        new_node.heartbeat_time = 0
+        return new_node
+
+    def __repr__(self):
+        return (
+            f"Node(type={self.type}, id={self.id}, rank={self.rank_index}, "
+            f"status={self.status}, relaunches={self.relaunch_count})"
+        )
+
+
+@dataclass
+class NodeEvent:
+    """An observed change of a node, produced by watchers or the agent."""
+
+    event_type: str = NodeEventType.MODIFIED
+    node: Optional[Node] = None
+
+    def is_node_check_failed(self) -> bool:
+        return self.event_type == NodeEventType.NODE_CHECK_FAILED

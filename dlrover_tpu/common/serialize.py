@@ -1,0 +1,54 @@
+"""Message (de)serialization for control-plane RPC.
+
+The reference ships pickled dataclasses inside a 2-method gRPC service
+(dlrover/python/common/comm.py:105-560, serialize.py). We keep the same
+wire shape — a class-name tag + pickled payload — because control-plane
+messages are small and trusted (master and agents are the same codebase in
+the same security domain), but restrict unpickling to registered message
+classes to avoid arbitrary-code deserialization.
+"""
+
+import importlib
+import io
+import pickle
+from dataclasses import is_dataclass
+from typing import Any, Dict, Tuple, Type
+
+_ALLOWED_MODULE_PREFIXES = (
+    "dlrover_tpu.",
+    "builtins",
+    "collections",
+    "numpy",
+    "datetime",
+)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if module == "builtins" or any(
+            module == p.rstrip(".") or module.startswith(p)
+            for p in _ALLOWED_MODULE_PREFIXES
+        ):
+            return getattr(importlib.import_module(module), name)
+        raise pickle.UnpicklingError(
+            f"blocked unpickle of {module}.{name}: not a control-plane type"
+        )
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(data: bytes) -> Any:
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+class PickleSerializable:
+    """Mixin for messages; kept trivially small so dataclasses stay plain."""
+
+    def serialize(self) -> bytes:
+        return dumps(self)
+
+    @classmethod
+    def deserialize(cls, data: bytes):
+        return loads(data)

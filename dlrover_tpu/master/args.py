@@ -1,0 +1,35 @@
+"""Master CLI args (parity: reference dlrover/python/master/args.py)."""
+
+import argparse
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="dlrover-tpu job master")
+    parser.add_argument("--port", type=int, default=0, help="RPC port (0=auto)")
+    parser.add_argument("--job_name", type=str, default="dlrover-tpu-job")
+    parser.add_argument(
+        "--platform",
+        type=str,
+        default="local",
+        choices=["local", "k8s", "gke_tpu"],
+        help="cluster backend",
+    )
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument("--max_relaunch_count", type=int, default=3)
+    parser.add_argument("--namespace", type=str, default="default")
+    parser.add_argument(
+        "--transport", type=str, default="grpc", choices=["grpc", "http"]
+    )
+    parser.add_argument(
+        "--port_file",
+        type=str,
+        default="",
+        help="write the bound RPC port to this file (standalone bootstrap)",
+    )
+    parser.add_argument("--pre_check", action="store_true", default=False)
+    parser.add_argument("--network_check", action="store_true", default=False)
+    return parser
+
+
+def parse_master_args(args=None):
+    return build_master_parser().parse_args(args)

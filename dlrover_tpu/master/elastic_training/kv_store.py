@@ -1,0 +1,56 @@
+"""Master-hosted KV store used by workers as a rendezvous store / barrier.
+
+Parity: reference master KV store served via servicer kv_store RPCs and
+consumed by elastic_agent/torch/master_kv_store.py. JAX side consumes it
+for exit barriers and cross-host handshakes that must not ride collectives.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def set(self, key: str, value: bytes):
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, delta: int) -> int:
+        with self._cond:
+            current = int(self._store.get(key, b"0") or b"0")
+            current += delta
+            self._store[key] = str(current).encode()
+            self._cond.notify_all()
+            return current
+
+    def multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        with self._lock:
+            return {k: self._store[k] for k in keys if k in self._store}
+
+    def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
+        deadline = time.time() + timeout
+        with self._cond:
+            while not all(k in self._store for k in keys):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()

@@ -1,0 +1,364 @@
+"""Master-side rendezvous.
+
+Parity: reference dlrover/python/master/elastic_training/rdzv_manager.py
+(RendezvousManager:69, ElasticTrainingRendezvousManager:497,
+NetworkCheckRendezvousManager:599). Re-designed for JAX: a completed round
+hands agents the ``jax.distributed.initialize`` triple (coordinator node,
+process count, per-node process id) instead of a torch process-group world.
+
+TPU specifics: the ``node_unit`` constraint generalizes to *legal topology
+sizes* — a TPU slice can only form meshes whose host count divides the
+physical topology, so a round is truncated to the largest legal node count
+<= the waiting set.
+"""
+
+import math
+import statistics
+import threading
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.constants import NetworkCheckConstant, RendezvousName
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class RendezvousParameters:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_unit: int = 1
+    waiting_timeout: float = 30.0  # secs after min reached before closing
+    join_timeout: float = 600.0
+
+
+@dataclass
+class _WaitingNode:
+    node_id: int
+    node_rank: int
+    local_world_size: int
+    join_time: float
+    node_ip: str = ""
+
+
+def default_legal_node_counts(max_nodes: int, node_unit: int) -> List[int]:
+    """Node counts that can form a legal mesh: multiples of node_unit."""
+    counts = [
+        n for n in range(node_unit, max_nodes + 1, node_unit)
+    ]
+    return counts or [max_nodes]
+
+
+class RendezvousManager(ABC):
+    """Holds the waiting set and completed rounds for one rendezvous name."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.RLock()
+        self._params = RendezvousParameters()
+        self._waiting: Dict[int, _WaitingNode] = {}  # node_rank -> record
+        self._latest_world: Dict[int, int] = {}  # rank -> local_world_size
+        self._rdzv_round = 0
+        self._round_start_time = 0.0
+        self._alive_nodes: Set[int] = set()
+        self._node_times: Dict[int, float] = {}
+        self._legal_counts_fn: Callable[[int, int], List[int]] = (
+            default_legal_node_counts
+        )
+
+    # ---- configuration -----------------------------------------------------
+
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+        join_timeout: float = 600.0,
+    ):
+        with self._lock:
+            self._params = RendezvousParameters(
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                node_unit=node_unit,
+                waiting_timeout=waiting_timeout,
+                join_timeout=join_timeout,
+            )
+
+    def set_legal_counts_fn(self, fn: Callable[[int, int], List[int]]):
+        """Install slice-topology-aware legal node counts."""
+        self._legal_counts_fn = fn
+
+    def set_node_unit(self, node_unit: int):
+        with self._lock:
+            if node_unit >= 1:
+                self._params.node_unit = node_unit
+
+    def add_alive_node(self, node_rank: int):
+        with self._lock:
+            self._alive_nodes.add(node_rank)
+
+    def remove_alive_node(self, node_rank: int):
+        with self._lock:
+            self._alive_nodes.discard(node_rank)
+            # A dead node must not keep a pending round open.
+            if node_rank in self._waiting:
+                del self._waiting[node_rank]
+
+    # ---- join / query ------------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        node_rank: int,
+        local_world_size: int,
+        node_ip: str = "",
+    ) -> int:
+        with self._lock:
+            if not self._waiting:
+                self._round_start_time = time.time()
+            self._waiting[node_rank] = _WaitingNode(
+                node_id=node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                join_time=time.time(),
+                node_ip=node_ip,
+            )
+            logger.info(
+                "rdzv[%s] round %d: node rank %d joined (%d waiting)",
+                self.name,
+                self._rdzv_round,
+                node_rank,
+                len(self._waiting),
+            )
+            return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """Non-zero signals running agents that membership wants to change
+        (reference rdzv_manager.py num_nodes_waiting / training.py
+        _membership_changed)."""
+        with self._lock:
+            return len(self._waiting)
+
+    @abstractmethod
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        """Return (round, group, world) — world empty if round incomplete."""
+
+    # ---- round completion --------------------------------------------------
+
+    def _legal_world_size(self, waiting_count: int) -> int:
+        p = self._params
+        counts = [
+            c
+            for c in self._legal_counts_fn(p.max_nodes, p.node_unit)
+            if c <= waiting_count
+        ]
+        return max(counts) if counts else 0
+
+    def _round_ready(self) -> int:
+        """Return the node count for a completable round, else 0."""
+        p = self._params
+        n = len(self._waiting)
+        if n == 0:
+            return 0
+        if n >= p.max_nodes:
+            return self._legal_world_size(p.max_nodes)
+        elapsed = time.time() - self._round_start_time
+        if n >= p.min_nodes and elapsed >= p.waiting_timeout:
+            return self._legal_world_size(n)
+        return 0
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """The training rendezvous: single group 0, ranks 0..n-1.
+
+    Reference: rdzv_manager.py:497 (ElasticTrainingRendezvousManager)."""
+
+    def __init__(self):
+        super().__init__(RendezvousName.TRAINING)
+
+    def get_comm_world(self, node_rank: int):
+        with self._lock:
+            if node_rank in self._latest_world and node_rank not in self._waiting:
+                return self._rdzv_round - 1, 0, dict(self._latest_world)
+            size = self._round_ready()
+            if size:
+                # Prefer longest-waiting nodes (lowest rank on tie) so a
+                # flapping late joiner cannot evict a stable participant.
+                chosen = sorted(
+                    self._waiting.values(),
+                    key=lambda w: (w.join_time, w.node_rank),
+                )[:size]
+                world = {
+                    w.node_rank: w.local_world_size for w in chosen
+                }
+                self._latest_world = dict(sorted(world.items()))
+                for w in chosen:
+                    del self._waiting[w.node_rank]
+                if self._waiting:
+                    # Unchosen nodes start the next pending round now.
+                    self._round_start_time = time.time()
+                self._rdzv_round += 1
+                logger.info(
+                    "rdzv[%s] round %d completed: world=%s",
+                    self.name,
+                    self._rdzv_round - 1,
+                    self._latest_world,
+                )
+            if node_rank in self._latest_world:
+                return self._rdzv_round - 1, 0, dict(self._latest_world)
+            return self._rdzv_round, 0, {}
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Rendezvous for the node/network check (reference rdzv_manager.py:599).
+
+    Round 0 groups nodes in pairs to run collective probes; round 1 pairs
+    each suspect with a known-healthy node so a failing pair is bisected to
+    the faulty member. Stragglers are nodes slower than
+    ``straggler_ratio x median``.
+    """
+
+    def __init__(self):
+        super().__init__(RendezvousName.NETWORK_CHECK)
+        self._node_status: Dict[int, bool] = {}
+        self._node_groups: List[Dict[int, int]] = []
+        self._check_round = 0
+        self._fault_nodes: Set[int] = set()
+        self._stragglers: Set[int] = set()
+        self._reported: Dict[int, float] = {}
+
+    def get_comm_world(self, node_rank: int):
+        with self._lock:
+            if not self._node_groups or all(
+                node_rank not in g for g in self._node_groups
+            ):
+                size = self._round_ready()
+                if size:
+                    chosen = sorted(
+                        self._waiting.values(),
+                        key=lambda w: (w.join_time, w.node_rank),
+                    )[:size]
+                    world = {w.node_rank: w.local_world_size for w in chosen}
+                    for w in chosen:
+                        del self._waiting[w.node_rank]
+                    self._latest_world = dict(sorted(world.items()))
+                    self._node_groups = self._group_nodes(
+                        self._check_round, self._latest_world
+                    )
+                    self._reported.clear()
+                    self._rdzv_round += 1
+                    logger.info(
+                        "network-check round %d groups: %s",
+                        self._check_round,
+                        self._node_groups,
+                    )
+            for group_idx, group in enumerate(self._node_groups):
+                if node_rank in group:
+                    return self._rdzv_round - 1, group_idx, dict(group)
+            return self._rdzv_round, 0, {}
+
+    def _group_nodes(
+        self, check_round: int, world: Dict[int, int]
+    ) -> List[Dict[int, int]]:
+        ranks = sorted(world)
+        groups: List[Dict[int, int]] = []
+        if check_round == 0 or not self._node_status:
+            # pairs: (0,1) (2,3) ...; odd node appended to last group
+            for i in range(0, len(ranks) - 1, 2):
+                groups.append(
+                    {r: world[r] for r in (ranks[i], ranks[i + 1])}
+                )
+            if len(ranks) % 2 == 1:
+                if groups:
+                    groups[-1][ranks[-1]] = world[ranks[-1]]
+                else:
+                    groups.append({ranks[-1]: world[ranks[-1]]})
+        else:
+            # round 1: suspect + healthy pairs
+            suspects = [r for r in ranks if not self._node_status.get(r, True)]
+            healthy = [r for r in ranks if self._node_status.get(r, True)]
+            used_healthy: List[int] = []
+            for s in suspects:
+                if healthy:
+                    h = healthy.pop(0)
+                    groups.append({s: world[s], h: world[h]})
+                    used_healthy.append(h)
+                else:
+                    groups.append({s: world[s]})
+            rest = healthy
+            for i in range(0, len(rest) - 1, 2):
+                groups.append({r: world[r] for r in (rest[i], rest[i + 1])})
+            if len(rest) % 2 == 1:
+                if groups:
+                    groups[-1][rest[-1]] = world[rest[-1]]
+                else:
+                    groups.append({rest[-1]: world[rest[-1]]})
+        return groups
+
+    def report_network_check_result(
+        self, node_rank: int, succeeded: bool, elapsed: float
+    ):
+        with self._lock:
+            self._reported[node_rank] = elapsed if succeeded else math.inf
+            # Round 0: failure marks the node suspect. Round 1: the verdict
+            # of the suspect+healthy pairing is final for this node.
+            self._node_status[node_rank] = succeeded
+
+    def check_fault_node(self) -> Tuple[List[int], int]:
+        """Return (fault_nodes, reason_round) once all reports are in."""
+        with self._lock:
+            expected = set(self._latest_world)
+            if expected and set(self._reported) >= expected:
+                if self._check_round == 0:
+                    suspects = {
+                        r for r, ok in self._node_status.items() if not ok
+                    }
+                    if suspects:
+                        self._check_round = 1
+                        # Force regrouping (suspect+healthy pairs) on the
+                        # next rendezvous round.
+                        self._node_groups = []
+                    self._fault_nodes = set()
+                else:
+                    self._fault_nodes = {
+                        r for r, ok in self._node_status.items() if not ok
+                    }
+            return sorted(self._fault_nodes), self._check_round
+
+    def check_straggler(self) -> List[int]:
+        with self._lock:
+            times = {
+                r: t
+                for r, t in self._reported.items()
+                if not math.isinf(t) and t > 0
+            }
+            if len(times) < 2:
+                return []
+            med = statistics.median(times.values())
+            if med <= 0:
+                return []
+            ratio = NetworkCheckConstant.STRAGGLER_RATIO
+            self._stragglers = {
+                r for r, t in times.items() if t > ratio * med
+            }
+            return sorted(self._stragglers)
+
+    def reset_check(self):
+        with self._lock:
+            self._check_round = 0
+            self._node_status.clear()
+            self._node_groups = []
+            self._fault_nodes.clear()
+            self._stragglers.clear()
+            self._reported.clear()
+
+
+def create_rdzv_managers() -> Dict[str, RendezvousManager]:
+    return {
+        RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+        RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+    }

@@ -1,0 +1,39 @@
+"""Named barriers across workers.
+
+Parity: reference master/elastic_training/sync_service.py:25 (SyncService).
+"""
+
+import threading
+from typing import Dict, Set
+
+
+class SyncService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._syncs: Dict[str, Set[int]] = {}
+        self._finished: Set[str] = set()
+
+    def join_sync(self, sync_name: str, node_rank: int) -> bool:
+        with self._lock:
+            self._syncs.setdefault(sync_name, set()).add(node_rank)
+            return True
+
+    def sync_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            self._finished.add(sync_name)
+            return True
+
+    def query(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished
+
+    def members(self, sync_name: str) -> Set[int]:
+        with self._lock:
+            return set(self._syncs.get(sync_name, set()))
+
+    def notify_finished_if_all(self, sync_name: str, world: Set[int]) -> bool:
+        with self._lock:
+            if self._syncs.get(sync_name, set()) >= world:
+                self._finished.add(sync_name)
+                return True
+            return False

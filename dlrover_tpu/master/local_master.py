@@ -1,0 +1,88 @@
+"""Standalone (single-host) job master.
+
+Parity: reference dlrover/python/master/local_master.py:41 (LocalJobMaster)
+— spawned by the run CLI in standalone mode so the full master protocol
+(rendezvous, KV store, data sharding, diagnosis) is available without a
+cluster.
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import JobConstant, JobStage
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    create_rdzv_managers,
+)
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.node.local_job_manager import LocalJobManager
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.rpc.transport import create_master_server
+
+
+class LocalJobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        job_name: str = "local-job",
+        node_num: int = 1,
+        max_relaunch_count: int = 3,
+        transport: str = "grpc",
+    ):
+        self.job_name = job_name
+        self._job_context = get_job_context()
+        self.job_manager = LocalJobManager(job_name, max_relaunch_count)
+        self.rdzv_managers = create_rdzv_managers()
+        self.perf_monitor = PerfMonitor()
+        self.task_manager = TaskManager(perf_monitor=self.perf_monitor)
+        self.servicer = MasterServicer(
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            perf_monitor=self.perf_monitor,
+        )
+        self._server = create_master_server(port, self.servicer, transport)
+        self.port = self._server.port
+        self._node_num = node_num
+        self._stopped = threading.Event()
+
+    def prepare(self):
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=self._node_num,
+                max_nodes=self._node_num,
+                waiting_timeout=5.0,
+            )
+        self._server.start()
+        self.job_manager.start()
+        self.task_manager.start()
+        logger.info(
+            "local master [%s] serving on port %d", self.job_name, self.port
+        )
+
+    def run(self) -> int:
+        """Supervision loop; returns exit code."""
+        try:
+            while not self._stopped.is_set():
+                time.sleep(JobConstant.MASTER_RUN_LOOP_INTERVAL)
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        logger.info("all workers succeeded; master exiting")
+                        return 0
+                    logger.error("workers failed; master exiting")
+                    return 1
+            return 0
+        finally:
+            self.stop()
+
+    def stop(self):
+        self._stopped.set()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop()
+
+    def request_stop(self):
+        self._stopped.set()

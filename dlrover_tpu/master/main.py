@@ -1,0 +1,50 @@
+"""Master process entry point.
+
+Parity: reference dlrover/python/master/main.py. Run as
+``python -m dlrover_tpu.master.main --platform local --node_num 2``.
+"""
+
+import os
+import sys
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.args import parse_master_args
+
+
+def run(args) -> int:
+    if args.platform == "local":
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(
+            port=args.port,
+            job_name=args.job_name,
+            node_num=args.node_num,
+            max_relaunch_count=args.max_relaunch_count,
+            transport=args.transport,
+        )
+    else:
+        try:
+            from dlrover_tpu.master.dist_master import DistributedJobMaster
+        except ImportError as e:
+            raise SystemExit(
+                f"platform {args.platform!r} requires the distributed "
+                f"master which is unavailable: {e}"
+            )
+        master = DistributedJobMaster.from_args(args)
+    master.prepare()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(master.port))
+        os.rename(tmp, args.port_file)
+    return master.run()
+
+
+def main(argv=None) -> int:
+    args = parse_master_args(argv)
+    logger.info("starting dlrover-tpu master: %s", vars(args))
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

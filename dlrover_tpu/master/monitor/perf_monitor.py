@@ -1,0 +1,106 @@
+"""Job-level performance monitor: step throughput and goodput accounting.
+
+Parity: reference dlrover/python/master/monitor/perf_monitor.py:45
+(PerfMonitor: global step speed, straggler-ish stats). Extended with an
+explicit goodput ledger — wall time attributed to train/ckpt/restart/
+rendezvous phases — because goodput-under-faults is this framework's
+north-star metric.
+"""
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Deque, Dict, Optional, Tuple
+
+from dlrover_tpu.common.constants import GoodputPhase
+from dlrover_tpu.common.log import logger
+
+
+class PerfMonitor:
+    def __init__(self, speed_window: int = 30):
+        self._lock = threading.Lock()
+        self._start_time = time.time()
+        self._global_step = 0
+        self._last_step_report: Optional[Tuple[int, float]] = None
+        self._speed_records: Deque[float] = deque(maxlen=speed_window)
+        self._total_train_secs = 0.0
+        # phase -> node_id -> seconds; goodput is averaged per node so a
+        # multi-node job cannot saturate the metric at 1.0.
+        self._phase_secs: Dict[str, Dict[int, float]] = defaultdict(
+            lambda: defaultdict(float)
+        )
+        self._max_phase_end = 0.0
+        self._init_time = time.time()
+
+    # ---- step speed --------------------------------------------------------
+
+    def collect_global_step(
+        self, step: int, timestamp: float, elapsed_train_secs: float = 0.0
+    ):
+        with self._lock:
+            if self._last_step_report is not None:
+                prev_step, prev_ts = self._last_step_report
+                dt = timestamp - prev_ts
+                dstep = step - prev_step
+                if dt > 0 and dstep > 0:
+                    self._speed_records.append(dstep / dt)
+            self._last_step_report = (step, timestamp)
+            self._global_step = max(self._global_step, step)
+            if elapsed_train_secs > 0:
+                self._total_train_secs += elapsed_train_secs
+
+    @property
+    def global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    def running_speed(self) -> float:
+        """Steps/sec over the sliding window."""
+        with self._lock:
+            if not self._speed_records:
+                return 0.0
+            return sum(self._speed_records) / len(self._speed_records)
+
+    def step_stagnated(self, timeout_secs: float) -> bool:
+        """True if no step progress has been reported for timeout_secs —
+        the cheap hang signal used by the hang diagnostician."""
+        with self._lock:
+            if self._last_step_report is None:
+                return False
+            return (time.time() - self._last_step_report[1]) > timeout_secs
+
+    # ---- goodput ledger ----------------------------------------------------
+
+    def collect_phase(self, node_id: int, phase: str, start: float, end: float):
+        if end <= start:
+            return
+        with self._lock:
+            self._phase_secs[phase][node_id] += end - start
+            self._max_phase_end = max(self._max_phase_end, end)
+
+    def goodput(self) -> float:
+        """Fraction of wall time spent in productive training, averaged
+        over reporting nodes."""
+        with self._lock:
+            wall = max(self._max_phase_end - self._init_time, 1e-9)
+            per_node = self._phase_secs.get(GoodputPhase.TRAIN, {})
+            if not per_node:
+                return 0.0
+            ratios = [min(t / wall, 1.0) for t in per_node.values()]
+            return sum(ratios) / len(ratios)
+
+    def phase_breakdown(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                phase: sum(nodes.values())
+                for phase, nodes in self._phase_secs.items()
+            }
+
+    def reset(self):
+        with self._lock:
+            self._global_step = 0
+            self._last_step_report = None
+            self._speed_records.clear()
+            self._phase_secs.clear()
+            self._init_time = time.time()
+            self._max_phase_end = 0.0

@@ -1,0 +1,368 @@
+"""Master RPC servicer: dispatches the get/report protocol to managers.
+
+Parity: reference dlrover/python/master/servicer.py (MasterServicer:89,
+dispatch by message type :152-208/:438-500). Dispatch here is an explicit
+type->handler table instead of method-name reflection, so the full RPC
+surface is greppable.
+"""
+
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.comm import Message
+from dlrover_tpu.common.constants import (
+    NodeType,
+    PreCheckStatus,
+    RendezvousName,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.elastic_training.elastic_ps import ClusterVersionService
+from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.rpc.transport import MasterService
+
+
+class MasterServicer(MasterService):
+    def __init__(
+        self,
+        rdzv_managers: Dict[str, RendezvousManager],
+        task_manager=None,
+        job_manager=None,
+        diagnosis_master=None,
+        perf_monitor=None,
+        sync_service: Optional[SyncService] = None,
+        kv_store: Optional[KVStoreService] = None,
+        job_metric_collector=None,
+        elastic_ps_service: Optional[ClusterVersionService] = None,
+    ):
+        self._rdzv_managers = rdzv_managers
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._diagnosis_master = diagnosis_master
+        self._perf_monitor = perf_monitor
+        self._sync_service = sync_service or SyncService()
+        self._kv_store = kv_store or KVStoreService()
+        self._job_metric_collector = job_metric_collector
+        self._elastic_ps_service = elastic_ps_service or ClusterVersionService()
+        self._pre_check_status = PreCheckStatus.PASS
+        self._elastic_run_config: Dict[str, str] = {}
+        self._start_time = time.time()
+
+        self._get_handlers = {
+            comm.CommWorldRequest: self._get_comm_world,
+            comm.NumNodesWaitingRequest: self._num_nodes_waiting,
+            comm.FaultNodeRequest: self._get_fault_nodes,
+            comm.StragglerRequest: self._get_stragglers,
+            comm.KVStoreGetRequest: self._kv_get,
+            comm.KVStoreMultiGetRequest: self._kv_multi_get,
+            comm.KVStoreAddRequest: self._kv_add,
+            comm.SyncQueryRequest: self._sync_query,
+            comm.TaskRequest: self._get_task,
+            comm.ShardCheckpointRequest: self._get_shard_checkpoint,
+            comm.CkptLatestStepRequest: self._get_ckpt_latest_step,
+            comm.PreCheckRequest: self._get_pre_check_result,
+            comm.ParallelConfigRequest: self._get_parallel_config,
+            comm.ElasticRunConfigRequest: self._get_elastic_run_config,
+            comm.JobDetailRequest: self._get_job_detail,
+            comm.ClusterVersionRequest: self._get_cluster_version,
+        }
+        self._report_handlers = {
+            comm.JoinRendezvousRequest: self._join_rendezvous,
+            comm.NetworkReadyRequest: self._network_ready,
+            comm.NetworkCheckResultReport: self._report_network_check,
+            comm.HeartbeatReport: self._report_heartbeat,
+            comm.NodeFailureReport: self._report_node_failure,
+            comm.SucceededRequest: self._report_succeeded,
+            comm.NodeEventReport: self._report_node_event,
+            comm.ResourceStats: self._report_resource_stats,
+            comm.GlobalStepReport: self._report_global_step,
+            comm.GoodputPhaseReport: self._report_goodput_phase,
+            comm.KVStoreSetRequest: self._kv_set,
+            comm.SyncJoinRequest: self._sync_join,
+            comm.SyncFinishRequest: self._sync_finish,
+            comm.DatasetShardParams: self._report_dataset_params,
+            comm.TaskDoneReport: self._report_task_done,
+            comm.ShardCheckpointRestoreRequest: self._restore_shard_checkpoint,
+            comm.CkptStepReport: self._report_ckpt_step,
+            comm.DiagnosisDataReport: self._report_diagnosis_data,
+            comm.ClusterVersionReport: self._report_cluster_version,
+        }
+
+    # ---- transport entry points -------------------------------------------
+
+    def get(self, message: Message) -> Message:
+        request = (
+            comm.BaseRequest.deserialize(message.data)
+            if message.data
+            else comm.BaseRequest()
+        )
+        handler = self._get_handlers.get(type(request))
+        if handler is None:
+            response = comm.BaseResponse(
+                success=False, reason=f"no get handler for {type(request)}"
+            )
+        else:
+            response = handler(message, request)
+        return Message(node_id=message.node_id, data=response.serialize())
+
+    def report(self, message: Message) -> Message:
+        request = (
+            comm.BaseRequest.deserialize(message.data)
+            if message.data
+            else comm.BaseRequest()
+        )
+        handler = self._report_handlers.get(type(request))
+        if handler is None:
+            response = comm.BaseResponse(
+                success=False, reason=f"no report handler for {type(request)}"
+            )
+        else:
+            response = handler(message, request)
+        return Message(node_id=message.node_id, data=response.serialize())
+
+    # ---- rendezvous --------------------------------------------------------
+
+    def _join_rendezvous(self, msg, req: comm.JoinRendezvousRequest):
+        mgr = self._rdzv_managers.get(req.rdzv_name)
+        if mgr is None:
+            return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
+        mgr.set_node_unit(req.node_unit)
+        rdzv_round = mgr.join_rendezvous(
+            req.node_id, req.node_rank, req.local_world_size, req.node_ip
+        )
+        if self._job_manager is not None:
+            self._job_manager.handle_node_joined(req.node_id, req.node_rank)
+        return comm.JoinRendezvousResponse(round=rdzv_round)
+
+    def _get_comm_world(self, msg, req: comm.CommWorldRequest):
+        mgr = self._rdzv_managers.get(req.rdzv_name)
+        if mgr is None:
+            return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
+        rdzv_round, group, world = mgr.get_comm_world(req.node_id)
+        coordinator_rank = min(world) if world else -1
+        return comm.CommWorld(
+            round=rdzv_round,
+            group=group,
+            world=world,
+            coordinator_rank=coordinator_rank,
+        )
+
+    def _num_nodes_waiting(self, msg, req: comm.NumNodesWaitingRequest):
+        mgr = self._rdzv_managers.get(req.rdzv_name)
+        waiting = mgr.num_nodes_waiting() if mgr else 0
+        return comm.NumNodesWaitingResponse(waiting_num=waiting)
+
+    # ---- network check -----------------------------------------------------
+
+    def _network_ready(self, msg, req):
+        return comm.BaseResponse(True)
+
+    def _report_network_check(self, msg, req: comm.NetworkCheckResultReport):
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if isinstance(mgr, NetworkCheckRendezvousManager):
+            mgr.report_network_check_result(
+                req.node_rank, req.succeeded, req.result
+            )
+        return comm.BaseResponse(True)
+
+    def _get_fault_nodes(self, msg, req):
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if isinstance(mgr, NetworkCheckRendezvousManager):
+            nodes, _ = mgr.check_fault_node()
+            return comm.FaultNodeResponse(fault_nodes=nodes)
+        return comm.FaultNodeResponse()
+
+    def _get_stragglers(self, msg, req):
+        mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if isinstance(mgr, NetworkCheckRendezvousManager):
+            return comm.StragglerResponse(stragglers=mgr.check_straggler())
+        return comm.StragglerResponse()
+
+    # ---- heartbeat / diagnosis --------------------------------------------
+
+    def _report_heartbeat(self, msg, req: comm.HeartbeatReport):
+        actions = []
+        if self._job_manager is not None:
+            actions = self._job_manager.collect_node_heartbeat(
+                req.node_id, req.timestamp
+            )
+        return comm.HeartbeatResponse(actions=actions or [])
+
+    def _report_node_failure(self, msg, req: comm.NodeFailureReport):
+        logger.warning(
+            "node %d (rank %d) reported failure: %s exit=%d",
+            req.node_id,
+            req.node_rank,
+            req.error_data,
+            req.exit_code,
+        )
+        if self._job_manager is not None:
+            self._job_manager.handle_node_failure(req)
+        return comm.BaseResponse(True)
+
+    def _report_succeeded(self, msg, req: comm.SucceededRequest):
+        if self._job_manager is not None:
+            self._job_manager.handle_node_succeeded(req.node_id)
+        return comm.BaseResponse(True)
+
+    def _report_node_event(self, msg, req: comm.NodeEventReport):
+        if self._job_manager is not None:
+            self._job_manager.handle_reported_node_event(req)
+        return comm.BaseResponse(True)
+
+    def _report_diagnosis_data(self, msg, req: comm.DiagnosisDataReport):
+        if self._diagnosis_master is not None:
+            self._diagnosis_master.collect_diagnosis_data(req)
+        return comm.BaseResponse(True)
+
+    # ---- perf / resources --------------------------------------------------
+
+    def _report_resource_stats(self, msg, req: comm.ResourceStats):
+        if self._job_manager is not None:
+            self._job_manager.update_node_resource_usage(req)
+        return comm.BaseResponse(True)
+
+    def _report_global_step(self, msg, req: comm.GlobalStepReport):
+        if self._perf_monitor is not None:
+            self._perf_monitor.collect_global_step(
+                req.step, req.timestamp, req.elapsed_train_secs
+            )
+        return comm.BaseResponse(True)
+
+    def _report_goodput_phase(self, msg, req: comm.GoodputPhaseReport):
+        if self._perf_monitor is not None:
+            self._perf_monitor.collect_phase(
+                req.node_id, req.phase, req.start, req.end
+            )
+        return comm.BaseResponse(True)
+
+    # ---- kv store ----------------------------------------------------------
+
+    def _kv_set(self, msg, req: comm.KVStoreSetRequest):
+        self._kv_store.set(req.key, req.value)
+        return comm.BaseResponse(True)
+
+    def _kv_get(self, msg, req: comm.KVStoreGetRequest):
+        return comm.KVStoreGetResponse(value=self._kv_store.get(req.key))
+
+    def _kv_add(self, msg, req: comm.KVStoreAddRequest):
+        return comm.KVStoreAddResponse(
+            value=self._kv_store.add(req.key, req.delta)
+        )
+
+    def _kv_multi_get(self, msg, req: comm.KVStoreMultiGetRequest):
+        return comm.KVStoreMultiGetResponse(
+            values=self._kv_store.multi_get(req.keys)
+        )
+
+    # ---- sync --------------------------------------------------------------
+
+    def _sync_join(self, msg, req: comm.SyncJoinRequest):
+        self._sync_service.join_sync(req.sync_name, req.node_rank)
+        return comm.BaseResponse(True)
+
+    def _sync_finish(self, msg, req: comm.SyncFinishRequest):
+        self._sync_service.sync_finished(req.sync_name)
+        return comm.BaseResponse(True)
+
+    def _sync_query(self, msg, req: comm.SyncQueryRequest):
+        return comm.SyncQueryResponse(done=self._sync_service.query(req.sync_name))
+
+    # ---- data sharding -----------------------------------------------------
+
+    def _report_dataset_params(self, msg, req: comm.DatasetShardParams):
+        if self._task_manager is not None:
+            self._task_manager.new_dataset(req)
+        return comm.BaseResponse(True)
+
+    def _get_task(self, msg, req: comm.TaskRequest):
+        if self._task_manager is None:
+            return comm.ShardTask()
+        return self._task_manager.get_task(req.node_id, req.dataset_name)
+
+    def _report_task_done(self, msg, req: comm.TaskDoneReport):
+        if self._task_manager is not None:
+            self._task_manager.report_task_done(
+                req.dataset_name, req.task_id, req.node_id
+            )
+        return comm.BaseResponse(True)
+
+    def _get_shard_checkpoint(self, msg, req: comm.ShardCheckpointRequest):
+        if self._task_manager is None:
+            return comm.ShardCheckpointResponse(checkpoint="")
+        ckpt = self._task_manager.get_shard_checkpoint(req.dataset_name)
+        return comm.ShardCheckpointResponse(checkpoint=ckpt)
+
+    def _restore_shard_checkpoint(
+        self, msg, req: comm.ShardCheckpointRestoreRequest
+    ):
+        if self._task_manager is not None:
+            self._task_manager.restore_shard_checkpoint(
+                req.dataset_name, req.checkpoint
+            )
+        return comm.BaseResponse(True)
+
+    # ---- checkpoint coordination ------------------------------------------
+
+    def _report_ckpt_step(self, msg, req: comm.CkptStepReport):
+        if self._job_manager is not None:
+            self._job_manager.update_ckpt_step(req.node_id, req.step, req.committed)
+        return comm.BaseResponse(True)
+
+    def _get_ckpt_latest_step(self, msg, req):
+        step = -1
+        if self._job_manager is not None:
+            step = self._job_manager.get_committed_ckpt_step()
+        return comm.CkptLatestStepResponse(step=step)
+
+    # ---- pre-check / config / detail --------------------------------------
+
+    def set_pre_check_status(self, status: str):
+        self._pre_check_status = status
+
+    def _get_pre_check_result(self, msg, req):
+        if self._diagnosis_master is not None:
+            status = self._diagnosis_master.get_pre_check_status()
+        else:
+            status = self._pre_check_status
+        return comm.PreCheckResponse(status=status)
+
+    def set_elastic_run_config(self, config: Dict[str, str]):
+        self._elastic_run_config = dict(config)
+
+    def _get_elastic_run_config(self, msg, req):
+        return comm.ElasticRunConfigResponse(configs=self._elastic_run_config)
+
+    def _get_parallel_config(self, msg, req):
+        if self._job_manager is not None:
+            cfg = self._job_manager.get_parallel_config()
+            if cfg is not None:
+                return cfg
+        return comm.ParallelConfig()
+
+    def _get_job_detail(self, msg, req):
+        if self._job_manager is not None:
+            return self._job_manager.get_job_detail()
+        return comm.JobDetailResponse()
+
+    # ---- cluster version (PS parity) --------------------------------------
+
+    def _get_cluster_version(self, msg, req: comm.ClusterVersionRequest):
+        if req.version_type == ClusterVersionService.GLOBAL:
+            v = self._elastic_ps_service.get_global_version()
+        else:
+            v = self._elastic_ps_service.get_node_version(
+                req.task_type, req.task_id, req.version_type
+            )
+        return comm.ClusterVersionResponse(version=v)
+
+    def _report_cluster_version(self, msg, req: comm.ClusterVersionReport):
+        self._elastic_ps_service.update_node_version(
+            req.task_type, req.task_id, req.version_type, req.version
+        )
+        return comm.BaseResponse(True)

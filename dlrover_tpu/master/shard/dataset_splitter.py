@@ -1,0 +1,124 @@
+"""Split datasets into shards for dynamic dispatch.
+
+Parity: reference dlrover/python/master/shard/dataset_splitter.py
+(DatasetSplitter:92, TableDatasetSplitter:146, TextDatasetSplitter:259).
+A shard is a [start, end) record range; workers fetch shards as tasks so a
+slow/dead worker's pending shards get re-dispatched (data elasticity).
+"""
+
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class Shard:
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+
+class DatasetSplitter(ABC):
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+    ):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = max(shard_size, 1)
+        self._num_epochs = max(num_epochs, 1)
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> List[Shard]:
+        ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self._num_epochs
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Contiguous range shards over an indexed (table-like) dataset."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        max_shard_count: int = 50000,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+        self._max_shard_count = max_shard_count
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        shards = [
+            Shard(
+                name=self.dataset_name,
+                start=start,
+                end=min(start + self.shard_size, self.dataset_size),
+            )
+            for start in range(0, self.dataset_size, self.shard_size)
+        ][: self._max_shard_count]
+        if self._shuffle:
+            random.shuffle(shards)
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carrying explicit (possibly shuffled) record indices, for
+    line-oriented datasets where a worker reads specific rows."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        indices = list(range(self.dataset_size))
+        if self._shuffle:
+            random.shuffle(indices)
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(
+                    name=self.dataset_name,
+                    start=start,
+                    end=end,
+                    record_indices=indices[start:end],
+                )
+            )
+        return shards
+
+
+def create_dataset_splitter(
+    storage_type: str,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+) -> DatasetSplitter:
+    if storage_type == "text":
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    return TableDatasetSplitter(
+        dataset_name, dataset_size, shard_size, num_epochs, shuffle
+    )

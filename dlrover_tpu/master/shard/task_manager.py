@@ -1,0 +1,258 @@
+"""Dynamic data sharding: datasets -> shards -> dispatched tasks.
+
+Parity: reference dlrover/python/master/shard/task_manager.py and
+batch_dataset_manager.py — TODO/DOING queues, timeout re-queue, shard
+checkpoint/restore so a restarted job resumes exactly the unconsumed data.
+"""
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.shard.dataset_splitter import (
+    DatasetSplitter,
+    Shard,
+    create_dataset_splitter,
+)
+
+
+@dataclass
+class Task:
+    task_id: int
+    task_type: str
+    shard: Shard
+    epoch: int = 0
+
+    @classmethod
+    def create_invalid_task(cls) -> "Task":
+        return cls(-1, TaskType.NONE, Shard("", 0, 0))
+
+
+@dataclass
+class _DoingTask:
+    task: Task
+    node_id: int
+    start_time: float
+
+
+class BatchDatasetManager:
+    """Shard queue of one dataset (reference batch_dataset_manager.py:29)."""
+
+    def __init__(self, task_type: str, splitter: DatasetSplitter):
+        self._task_type = task_type
+        self._splitter = splitter
+        self.todo: List[Task] = []
+        self.doing: Dict[int, _DoingTask] = {}
+        self._task_id_seq = 0
+        self._completed_count = 0
+        self._lock = threading.Lock()
+
+    def get_task(self, node_id: int) -> Task:
+        with self._lock:
+            if not self.todo and not self._splitter.epoch_finished():
+                self._create_todo_tasks()
+            if not self.todo:
+                if self.doing:
+                    # Data remains in flight: tell the worker to wait, its
+                    # peers' shards may be re-queued on timeout/failure.
+                    return Task(-1, TaskType.WAIT, Shard("", 0, 0))
+                return Task.create_invalid_task()
+            task = self.todo.pop(0)
+            self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
+            return task
+
+    def _create_todo_tasks(self):
+        shards = self._splitter.create_shards()
+        epoch = self._splitter.epoch
+        for shard in shards:
+            self.todo.append(
+                Task(self._task_id_seq, self._task_type, shard, epoch)
+            )
+            self._task_id_seq += 1
+
+    def report_task_done(self, task_id: int, node_id: int) -> bool:
+        with self._lock:
+            doing = self.doing.pop(task_id, None)
+            if doing is None:
+                return False
+            self._completed_count += 1
+            return True
+
+    def recover_timeout_tasks(self, timeout: float):
+        with self._lock:
+            now = time.time()
+            expired = [
+                tid
+                for tid, d in self.doing.items()
+                if now - d.start_time > timeout
+            ]
+            for tid in expired:
+                doing = self.doing.pop(tid)
+                logger.warning(
+                    "task %d of node %d timed out; re-queueing",
+                    tid,
+                    doing.node_id,
+                )
+                self.todo.insert(0, doing.task)
+
+    def recover_node_tasks(self, node_id: int):
+        """Re-queue all in-flight shards of a dead node."""
+        with self._lock:
+            lost = [
+                tid for tid, d in self.doing.items() if d.node_id == node_id
+            ]
+            for tid in lost:
+                self.todo.insert(0, self.doing.pop(tid).task)
+
+    def completed(self) -> bool:
+        with self._lock:
+            return (
+                self._splitter.epoch_finished()
+                and not self.todo
+                and not self.doing
+            )
+
+    # ---- shard checkpoint --------------------------------------------------
+
+    def checkpoint(self) -> dict:
+        with self._lock:
+            undone = [
+                [t.task.shard.start, t.task.shard.end, t.task.shard.record_indices]
+                for t in self.doing.values()
+            ] + [
+                [t.shard.start, t.shard.end, t.shard.record_indices]
+                for t in self.todo
+            ]
+            return {
+                "epoch": self._splitter.epoch,
+                "undone_shards": undone,
+                "completed": self._completed_count,
+            }
+
+    def restore(self, state: dict, dataset_name: str):
+        with self._lock:
+            self.todo.clear()
+            self.doing.clear()
+            self._splitter.epoch = state.get("epoch", 0)
+            self._completed_count = state.get("completed", 0)
+            for entry in state.get("undone_shards", []):
+                start, end = entry[0], entry[1]
+                indices = entry[2] if len(entry) > 2 else None
+                self.todo.append(
+                    Task(
+                        self._task_id_seq,
+                        self._task_type,
+                        Shard(dataset_name, start, end, indices),
+                        self._splitter.epoch,
+                    )
+                )
+                self._task_id_seq += 1
+
+
+class TaskManager:
+    """Owns all dataset managers; periodic timeout recovery thread.
+
+    Parity: reference master/shard/task_manager.py (TaskManager).
+    """
+
+    def __init__(self, task_timeout: float = 1800.0, perf_monitor=None):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._task_timeout = task_timeout
+        self._perf_monitor = perf_monitor
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._recover_loop, daemon=True, name="task-recover"
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _recover_loop(self):
+        while not self._stopped.wait(30):
+            with self._lock:
+                managers = list(self._datasets.values())
+            for m in managers:
+                m.recover_timeout_tasks(self._task_timeout)
+
+    # ---- servicer surface --------------------------------------------------
+
+    def new_dataset(self, params: comm.DatasetShardParams):
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            splitter = create_dataset_splitter(
+                params.storage_type,
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+                params.shuffle,
+            )
+            self._datasets[params.dataset_name] = BatchDatasetManager(
+                params.task_type, splitter
+            )
+            logger.info(
+                "dataset %s registered: size=%d shard=%d epochs=%d",
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+            )
+
+    def get_dataset(self, name: str) -> Optional[BatchDatasetManager]:
+        with self._lock:
+            return self._datasets.get(name)
+
+    def get_task(self, node_id: int, dataset_name: str) -> comm.ShardTask:
+        mgr = self.get_dataset(dataset_name)
+        if mgr is None:
+            return comm.ShardTask()
+        task = mgr.get_task(node_id)
+        return comm.ShardTask(
+            task_id=task.task_id,
+            task_type=task.task_type,
+            dataset_name=dataset_name,
+            start=task.shard.start,
+            end=task.shard.end,
+            epoch=task.epoch,
+            record_indices=task.shard.record_indices,
+        )
+
+    def report_task_done(self, dataset_name: str, task_id: int, node_id: int):
+        mgr = self.get_dataset(dataset_name)
+        if mgr is not None:
+            mgr.report_task_done(task_id, node_id)
+
+    def recover_node_tasks(self, node_id: int):
+        with self._lock:
+            managers = list(self._datasets.values())
+        for m in managers:
+            m.recover_node_tasks(node_id)
+
+    def finished(self) -> bool:
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(m.completed() for m in self._datasets.values())
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        mgr = self.get_dataset(dataset_name)
+        if mgr is None:
+            return ""
+        return json.dumps(mgr.checkpoint())
+
+    def restore_shard_checkpoint(self, dataset_name: str, checkpoint: str):
+        mgr = self.get_dataset(dataset_name)
+        if mgr is not None and checkpoint:
+            mgr.restore(json.loads(checkpoint), dataset_name)

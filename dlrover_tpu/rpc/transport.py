@@ -1,0 +1,236 @@
+"""Control-plane RPC transport.
+
+Two unary methods — ``get`` and ``report`` — carrying an opaque pickled
+:class:`dlrover_tpu.common.comm.Message` envelope, mirroring the
+reference's wire protocol (proto/elastic_training.proto:26-29,
+master/servicer.py:912 GrpcMasterServicer, elastic_agent/master_client.py).
+
+Implemented with gRPC *generic* method handlers so no protoc-generated stubs
+are required; bytes in, bytes out. An HTTP transport with the same two-verb
+surface is provided for environments without gRPC (reference
+servicer.py:994 HttpMasterServicer).
+"""
+
+import abc
+import http.client
+import json
+import threading
+import time
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import grpc
+
+from dlrover_tpu.common.comm import Message
+from dlrover_tpu.common.log import logger
+
+SERVICE_NAME = "dlrover_tpu.Master"
+GET_METHOD = f"/{SERVICE_NAME}/get"
+REPORT_METHOD = f"/{SERVICE_NAME}/report"
+
+GRPC_MAX_MESSAGE = 512 * 1024 * 1024  # checkpoints metadata can be chunky
+
+
+class MasterService(abc.ABC):
+    """What a master must implement to be served over any transport."""
+
+    @abc.abstractmethod
+    def get(self, message: Message) -> Message:
+        ...
+
+    @abc.abstractmethod
+    def report(self, message: Message) -> Message:
+        ...
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, service: MasterService):
+        self._service = service
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == GET_METHOD:
+            return grpc.unary_unary_rpc_method_handler(self._handle_get)
+        if method == REPORT_METHOD:
+            return grpc.unary_unary_rpc_method_handler(self._handle_report)
+        return None
+
+    def _handle_get(self, request: bytes, context) -> bytes:
+        try:
+            msg = Message.deserialize(request)
+            return self._service.get(msg).serialize()
+        except Exception:
+            logger.exception("error handling get RPC")
+            context.abort(grpc.StatusCode.INTERNAL, "get failed")
+
+    def _handle_report(self, request: bytes, context) -> bytes:
+        try:
+            msg = Message.deserialize(request)
+            return self._service.report(msg).serialize()
+        except Exception:
+            logger.exception("error handling report RPC")
+            context.abort(grpc.StatusCode.INTERNAL, "report failed")
+
+
+class GrpcMasterServer:
+    def __init__(self, port: int, service: MasterService, max_workers: int = 64):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE),
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE),
+            ],
+        )
+        self._server.add_generic_rpc_handlers([_GenericHandler(service)])
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"failed to bind master RPC port {port}")
+
+    def start(self):
+        self._server.start()
+
+    def stop(self, grace: float = 1.0):
+        self._server.stop(grace)
+
+
+class GrpcMasterStub:
+    """Client side of the two-verb protocol."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE),
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE),
+                # No transparent transport retries: mutations (kv add,
+                # rendezvous join) must be applied at most once per call.
+                ("grpc.enable_retries", 0),
+            ],
+        )
+        self._get = self._channel.unary_unary(GET_METHOD)
+        self._report = self._channel.unary_unary(REPORT_METHOD)
+
+    def get(self, message: Message, timeout: Optional[float] = None) -> Message:
+        data = self._get(message.serialize(), timeout=timeout or self._timeout)
+        return Message.deserialize(data)
+
+    def report(self, message: Message, timeout: Optional[float] = None) -> Message:
+        data = self._report(
+            message.serialize(), timeout=timeout or self._timeout
+        )
+        return Message.deserialize(data)
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+            return True
+        except grpc.FutureTimeoutError:
+            return False
+
+    def close(self):
+        self._channel.close()
+
+
+# --------------------------------------------------------------------------
+# HTTP transport (same two-verb surface, stdlib only)
+# --------------------------------------------------------------------------
+
+
+class _HttpHandler(BaseHTTPRequestHandler):
+    service: MasterService = None  # class attr injected by server factory
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            msg = Message.deserialize(body)
+            if self.path == "/get":
+                resp = self.service.get(msg)
+            elif self.path == "/report":
+                resp = self.service.report(msg)
+            else:
+                self.send_error(404)
+                return
+            payload = resp.serialize()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except Exception:
+            logger.exception("error handling HTTP RPC %s", self.path)
+            self.send_error(500)
+
+
+class HttpMasterServer:
+    def __init__(self, port: int, service: MasterService):
+        handler = type("BoundHandler", (_HttpHandler,), {"service": service})
+        self._httpd = ThreadingHTTPServer(("", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-master"
+        )
+        self._thread.start()
+
+    def stop(self, grace: float = 1.0):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class HttpMasterStub:
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._host, port = addr.rsplit(":", 1)
+        self._port = int(port)
+        self._timeout = timeout
+
+    def _call(self, path: str, message: Message, timeout=None) -> Message:
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=timeout or self._timeout
+        )
+        try:
+            conn.request("POST", path, body=message.serialize())
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"RPC {path} failed: HTTP {resp.status}")
+            return Message.deserialize(resp.read())
+        finally:
+            conn.close()
+
+    def get(self, message: Message, timeout=None) -> Message:
+        return self._call("/get", message, timeout)
+
+    def report(self, message: Message, timeout=None) -> Message:
+        return self._call("/report", message, timeout)
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                self._call("/get", Message())
+                return True
+            except Exception:
+                time.sleep(0.5)
+        return False
+
+    def close(self):
+        pass
+
+
+def create_master_server(port: int, service: MasterService, kind: str = "grpc"):
+    if kind == "http":
+        return HttpMasterServer(port, service)
+    return GrpcMasterServer(port, service)
+
+
+def build_master_stub(addr: str, kind: str = "grpc", timeout: float = 10.0):
+    if kind == "http":
+        return HttpMasterStub(addr, timeout)
+    return GrpcMasterStub(addr, timeout)

@@ -1,0 +1,123 @@
+"""Rendezvous manager unit tests (reference: tests/test_rdzv_manager.py)."""
+
+import math
+import time
+
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    default_legal_node_counts,
+)
+
+
+def test_round_completes_at_max_nodes():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60)
+    mgr.join_rendezvous(0, 0, 1)
+    rnd, _, world = mgr.get_comm_world(0)
+    assert world == {}
+    mgr.join_rendezvous(1, 1, 1)
+    rnd, _, world = mgr.get_comm_world(0)
+    assert world == {0: 1, 1: 1}
+    assert mgr.num_nodes_waiting() == 0
+
+
+def test_round_completes_with_min_after_timeout():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=1, max_nodes=4, waiting_timeout=0.2)
+    mgr.join_rendezvous(0, 0, 1)
+    _, _, world = mgr.get_comm_world(0)
+    assert world == {}
+    time.sleep(0.25)
+    _, _, world = mgr.get_comm_world(0)
+    assert world == {0: 1}
+
+
+def test_node_unit_truncates_world():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(
+        min_nodes=2, max_nodes=8, waiting_timeout=0.1, node_unit=2
+    )
+    for i in range(5):
+        mgr.join_rendezvous(i, i, 1)
+    time.sleep(0.15)
+    _, _, world = mgr.get_comm_world(0)
+    # 5 waiting, node_unit=2 => world of 4; the longest-waiting 4 chosen
+    assert len(world) == 4
+    assert mgr.num_nodes_waiting() == 1
+
+
+def test_legal_counts_fn_mesh_topologies():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=8, waiting_timeout=0.1)
+    # TPU-slice style: only power-of-two host counts form legal meshes
+    mgr.set_legal_counts_fn(
+        lambda max_n, unit: [n for n in (1, 2, 4, 8) if n <= max_n]
+    )
+    for i in range(7):
+        mgr.join_rendezvous(i, i, 1)
+    time.sleep(0.15)
+    _, _, world = mgr.get_comm_world(0)
+    assert len(world) == 4
+
+
+def test_dead_node_removed_from_waiting():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60)
+    mgr.join_rendezvous(0, 0, 1)
+    mgr.join_rendezvous(1, 1, 1)
+    mgr.remove_alive_node(1)
+    _, _, world = mgr.get_comm_world(0)
+    assert world == {}
+
+
+def test_network_check_pair_grouping_and_fault_isolation():
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60)
+    for i in range(4):
+        mgr.join_rendezvous(i, i, 1)
+    _, g0, world0 = mgr.get_comm_world(0)
+    _, g2, world2 = mgr.get_comm_world(2)
+    assert world0 == {0: 1, 1: 1}
+    assert world2 == {2: 1, 3: 1}
+    # round 0: node 3's group fails
+    mgr.report_network_check_result(0, True, 1.0)
+    mgr.report_network_check_result(1, True, 1.0)
+    mgr.report_network_check_result(2, False, math.inf)
+    mgr.report_network_check_result(3, False, math.inf)
+    faults, check_round = mgr.check_fault_node()
+    assert faults == [] and check_round == 1  # suspects need round 1
+    # round 1: suspects paired with healthy nodes
+    for i in range(4):
+        mgr.join_rendezvous(i, i, 1)
+    groups = {}
+    for i in range(4):
+        _, g, w = mgr.get_comm_world(i)
+        groups[i] = set(w)
+    # each suspect (2,3) grouped with a healthy node (0,1)
+    assert any(2 in g and (0 in g or 1 in g) for g in groups.values())
+    # suspect 2 passes with healthy partner; 3 fails again
+    mgr.report_network_check_result(0, True, 1.0)
+    mgr.report_network_check_result(1, True, 1.0)
+    mgr.report_network_check_result(2, True, 1.1)
+    mgr.report_network_check_result(3, False, math.inf)
+    faults, _ = mgr.check_fault_node()
+    assert faults == [3]
+
+
+def test_straggler_detection():
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60)
+    for i in range(4):
+        mgr.join_rendezvous(i, i, 1)
+        mgr.get_comm_world(i)
+    mgr.report_network_check_result(0, True, 1.0)
+    mgr.report_network_check_result(1, True, 1.1)
+    mgr.report_network_check_result(2, True, 0.9)
+    mgr.report_network_check_result(3, True, 5.0)  # > 2x median
+    assert mgr.check_straggler() == [3]
+
+
+def test_default_legal_counts():
+    assert default_legal_node_counts(8, 2) == [2, 4, 6, 8]
+    assert default_legal_node_counts(3, 1) == [1, 2, 3]

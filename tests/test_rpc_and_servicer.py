@@ -1,0 +1,137 @@
+"""RPC transport + servicer dispatch tests (in-process master).
+
+Mirrors the reference's mock-everything unit style
+(dlrover/python/tests/test_servicer.py pattern): a real gRPC server on a
+random port, a real client, no cluster.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import RendezvousName, TaskType
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+
+@pytest.fixture()
+def master():
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    JobContext.reset_singleton()
+    m = LocalJobMaster(port=0, node_num=2)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(f"localhost:{master.port}", node_id=0)
+    assert c.wait_master_ready(30)
+    yield c
+    c.close()
+
+
+def _mk_client(master, node_id):
+    return MasterClient(f"localhost:{master.port}", node_id=node_id)
+
+
+def test_rendezvous_round(master, client):
+    c1 = _mk_client(master, 1)
+    client.join_rendezvous(0, 1, RendezvousName.TRAINING)
+    c1.join_rendezvous(1, 1, RendezvousName.TRAINING)
+    rnd, group, world = client.get_comm_world(RendezvousName.TRAINING, 0)
+    assert world == {0: 1, 1: 1}
+    # second node sees the same completed round
+    rnd2, _, world2 = c1.get_comm_world(RendezvousName.TRAINING, 1)
+    assert world2 == world
+    assert rnd2 == rnd
+    assert client.num_nodes_waiting(RendezvousName.TRAINING) == 0
+
+
+def test_kv_store_and_sync(master, client):
+    client.kv_store_set("alpha", b"1")
+    assert client.kv_store_get("alpha") == b"1"
+    assert client.kv_store_add("ctr", 2) == 2
+    assert client.kv_store_add("ctr", 3) == 5
+    assert client.kv_store_multi_get(["alpha", "ctr"]) == {
+        "alpha": b"1",
+        "ctr": b"5",
+    }
+    client.join_sync("barrier1", 0)
+    assert not client.sync_barrier("barrier1")
+    client.sync_finished("barrier1")
+    assert client.sync_barrier("barrier1")
+
+
+def test_data_sharding_flow(master, client):
+    params = comm.DatasetShardParams(
+        dataset_name="ds",
+        dataset_size=10,
+        shard_size=4,
+        num_epochs=1,
+        storage_type="table",
+        task_type=TaskType.TRAINING,
+    )
+    client.report_dataset_shard_params(params)
+    seen = []
+    while True:
+        task = client.get_task("ds")
+        if task.task_id < 0 and task.task_type != TaskType.WAIT:
+            break
+        if task.task_type == TaskType.WAIT:
+            time.sleep(0.05)
+            continue
+        seen.append((task.start, task.end))
+        client.report_task_done("ds", task.task_id)
+    assert sorted(seen) == [(0, 4), (4, 8), (8, 10)]
+
+
+def test_shard_checkpoint_restore(master, client):
+    params = comm.DatasetShardParams(
+        dataset_name="ds2", dataset_size=8, shard_size=4, num_epochs=1
+    )
+    client.report_dataset_shard_params(params)
+    t1 = client.get_task("ds2")  # in-flight, never completed
+    ckpt = client.get_shard_checkpoint("ds2")
+    assert ckpt
+    client.restore_shard_checkpoint("ds2", ckpt)
+    # all shards are back in TODO
+    starts = set()
+    while True:
+        t = client.get_task("ds2")
+        if t.task_id < 0:
+            break
+        starts.add(t.start)
+        client.report_task_done("ds2", t.task_id)
+    assert starts == {0, 4}
+
+
+def test_heartbeat_and_ckpt_step(master, client):
+    actions = client.report_heartbeat()
+    assert actions == []
+    client.report_ckpt_step(10, committed=False)
+    assert client.get_ckpt_latest_step() == -1
+    client.report_ckpt_step(10, committed=True)
+    assert client.get_ckpt_latest_step() == 10
+
+
+def test_failure_and_success_reports(master, client):
+    client.join_rendezvous(0, 1, RendezvousName.TRAINING)
+    client.report_failure("boom", node_rank=0, restart_count=1, exit_code=1)
+    client.report_succeeded()
+    detail = client.get_job_detail()
+    assert 0 in detail.nodes
+
+
+def test_pre_check_and_config(master, client):
+    assert client.get_pre_check_result() == "PASS"
+    master.servicer.set_elastic_run_config({"network_check": "false"})
+    assert client.get_elastic_run_config() == {"network_check": "false"}
+
+
+def test_cluster_version(master, client):
+    client.update_cluster_version("local", 3, "worker", 0)
+    assert client.get_cluster_version("local", "worker", 0) == 3
