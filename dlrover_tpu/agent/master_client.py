@@ -129,9 +129,10 @@ class MasterClient:
         )
 
     @retry_rpc
-    def check_fault_node(self) -> List[int]:
+    def check_fault_node(self):
+        """Returns (fault_nodes, evaluated_round, needs_round2)."""
         resp = self._get(comm.FaultNodeRequest())
-        return resp.fault_nodes
+        return resp.fault_nodes, resp.evaluated_round, resp.needs_round2
 
     @retry_rpc
     def check_straggler(self) -> List[int]:

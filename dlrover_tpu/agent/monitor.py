@@ -1,0 +1,56 @@
+"""Agent-side node resource monitor.
+
+Parity: reference elastic_agent/monitor/resource.py (ResourceMonitor —
+psutil/pynvml sampling -> report_used_resource) and monitor/training.py
+(TorchTrainingMonitor). TPU utilization comes from the worker's own step
+reports (and, when present, the native profiler's metrics endpoint) rather
+than a NVML analogue.
+"""
+
+import os
+import threading
+import time
+from typing import Optional
+
+import psutil
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import logger
+
+
+class ResourceMonitor:
+    def __init__(
+        self,
+        client: MasterClient,
+        interval: float = 15.0,
+    ):
+        self._client = client
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._process = psutil.Process(os.getpid())
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="resource-monitor"
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _sample(self):
+        cpu = psutil.cpu_percent(interval=None)
+        mem = psutil.virtual_memory()
+        used_mb = (mem.total - mem.available) / (1024 * 1024)
+        return cpu, used_mb
+
+    def _run(self):
+        psutil.cpu_percent(interval=None)  # prime the sampler
+        while not self._stopped.wait(self._interval):
+            try:
+                cpu, mem_mb = self._sample()
+                self._client.report_used_resource(cpu, mem_mb)
+            except Exception:
+                logger.debug("resource sample failed", exc_info=True)

@@ -1,0 +1,83 @@
+"""Node/ICI health probe executed as a worker process.
+
+Parity: reference trainer/torch/node_check/nvidia_gpu.py:40-84 (matmul
+rounds + allreduce) — TPU version: an MXU-shaped bf16 matmul on every
+local device plus a psum across the probe group (ICI/DCN when the group
+spans hosts). Writes elapsed seconds to the result file; any exception
+leaves no result, which the agent reports as a failed probe.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    result_file = sys.argv[1]
+    matmul_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    rounds = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+    comm_mb = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+
+    from dlrover_tpu.trainer.runtime import init_distributed
+
+    ctx = init_distributed()
+    import jax
+    import jax.numpy as jnp
+
+    start = time.time()
+
+    # MXU probe: bf16 GEMM chain, one per local device.
+    @jax.jit
+    def gemm_chain(x):
+        for _ in range(8):
+            x = jnp.dot(x, x, preferred_element_type=jnp.float32).astype(
+                jnp.bfloat16
+            )
+            x = x / (jnp.max(jnp.abs(x)) + 1.0)
+        return x
+
+    for device in jax.local_devices():
+        key = jax.random.PRNGKey(0)
+        x = jax.device_put(
+            jax.random.normal(
+                key, (matmul_size, matmul_size), dtype=jnp.bfloat16
+            ),
+            device,
+        )
+        for _ in range(rounds // 8 or 1):
+            x = gemm_chain(x)
+        jax.block_until_ready(x)
+
+    # Collective probe across the whole probe world (ICI within a slice,
+    # DCN across slices). Uses psum over all devices via pmap-free jit
+    # with a 1D mesh of every global device.
+    if comm_mb > 0 and jax.device_count() > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = jax.devices()
+        mesh = Mesh(devices, ("probe",))
+        n = (comm_mb * 1024 * 1024 // 4 // len(devices)) * len(devices)
+        arr = jnp.ones((n,), dtype=jnp.float32)
+        sharded = jax.device_put(
+            arr, NamedSharding(mesh, P("probe"))
+        )
+
+        @jax.jit
+        def allreduce(x):
+            # a reduction whose result every device needs: XLA emits an
+            # all-reduce over the mesh
+            return x + jnp.sum(x)
+
+        out = allreduce(sharded)
+        jax.block_until_ready(out)
+
+    elapsed = time.time() - start
+    tmp = result_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{elapsed:.6f}")
+    os.replace(tmp, result_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

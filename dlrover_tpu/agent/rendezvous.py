@@ -1,0 +1,148 @@
+"""Agent-side rendezvous: from master comm-world to JAX coordination.
+
+Parity: reference elastic_agent MasterRendezvousHandler
+(elastic_agent/torch/training.py:405-646). Where torchelastic assembles a
+process group store, this produces the ``jax.distributed.initialize``
+triple: the lowest-rank node in the completed world hosts the JAX
+coordinator; its agent publishes ``host:port`` in the master KV store keyed
+by rendezvous round, and every agent derives contiguous process ids from
+the world layout.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.env_utils import find_free_port, get_hostname_ip
+from dlrover_tpu.common.log import logger
+
+
+class RendezvousTimeoutError(Exception):
+    pass
+
+
+class RendezvousEvictedError(Exception):
+    """This node was not chosen into the completed world."""
+
+
+@dataclass
+class RendezvousOutcome:
+    round: int
+    group: int  # pair group during network check; 0 for training
+    world: Dict[int, int]  # node_rank -> local_world_size
+    coordinator_address: str
+    num_processes: int
+    process_id_base: int  # first global process id of this node
+    node_world_size: int  # number of nodes in the world
+    is_coordinator: bool
+
+
+class MasterRendezvousHandler:
+    def __init__(
+        self,
+        client: MasterClient,
+        node_rank: int,
+        local_world_size: int = 1,
+        rdzv_name: str = RendezvousName.TRAINING,
+        node_unit: int = 1,
+        join_timeout: float = 600.0,
+        poll_interval: float = 0.5,
+        coordinator_port: int = 0,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._rdzv_name = rdzv_name
+        self._node_unit = node_unit
+        self._join_timeout = join_timeout
+        self._poll_interval = poll_interval
+        self._coordinator_port = coordinator_port
+        _, self._node_ip = get_hostname_ip()
+
+    def _coordinator_key(self, rdzv_round: int, group: int) -> str:
+        return f"rdzv/{self._rdzv_name}/{rdzv_round}/{group}/coordinator"
+
+    def next_rendezvous(self) -> RendezvousOutcome:
+        """Join, wait for the world, agree on the JAX coordinator."""
+        self._client.join_rendezvous(
+            self._node_rank,
+            self._local_world_size,
+            self._rdzv_name,
+            node_unit=self._node_unit,
+            node_ip=self._node_ip,
+        )
+        deadline = time.time() + self._join_timeout
+        world: Dict[int, int] = {}
+        rdzv_round = 0
+        group = 0
+        while time.time() < deadline:
+            rdzv_round, group, world = self._client.get_comm_world(
+                self._rdzv_name, self._node_rank
+            )
+            if world:
+                if self._node_rank in world:
+                    break
+                # A round completed without us: we were truncated out
+                # (illegal topology count) — surface as eviction so the
+                # caller can rejoin or exit.
+                raise RendezvousEvictedError(
+                    f"node {self._node_rank} not in world {sorted(world)}"
+                )
+            time.sleep(self._poll_interval)
+        if not world or self._node_rank not in world:
+            raise RendezvousTimeoutError(
+                f"rendezvous {self._rdzv_name} timed out after "
+                f"{self._join_timeout}s"
+            )
+
+        ranks = sorted(world)
+        num_processes = sum(world.values())
+        process_id_base = sum(
+            world[r] for r in ranks if r < self._node_rank
+        )
+        coordinator_rank = ranks[0]
+        is_coordinator = coordinator_rank == self._node_rank
+        key = self._coordinator_key(rdzv_round, group)
+        if is_coordinator:
+            port = self._coordinator_port or find_free_port()
+            coordinator = f"{self._node_ip}:{port}"
+            self._client.kv_store_set(key, coordinator.encode())
+        else:
+            coordinator = self._wait_coordinator(key, deadline)
+        logger.info(
+            "rdzv[%s] round %d: world=%s coordinator=%s procs=%d base=%d",
+            self._rdzv_name,
+            rdzv_round,
+            world,
+            coordinator,
+            num_processes,
+            process_id_base,
+        )
+        return RendezvousOutcome(
+            round=rdzv_round,
+            group=group,
+            world=dict(world),
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id_base=process_id_base,
+            node_world_size=len(world),
+            is_coordinator=is_coordinator,
+        )
+
+    def _wait_coordinator(self, key: str, deadline: float) -> str:
+        while time.time() < deadline:
+            value = self._client.kv_store_get(key)
+            if value:
+                return value.decode()
+            time.sleep(self._poll_interval)
+        raise RendezvousTimeoutError(
+            f"coordinator address never published under {key}"
+        )
+
+    def num_nodes_waiting(self) -> int:
+        try:
+            return self._client.num_nodes_waiting(self._rdzv_name)
+        except Exception:
+            return 0

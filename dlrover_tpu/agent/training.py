@@ -1,0 +1,374 @@
+"""The elastic agent: per-node supervisor of JAX worker processes.
+
+Parity: reference dlrover/python/elastic_agent/torch/training.py
+(ElasticTrainingAgent:648, _invoke_run:1247, _initialize_workers:1073).
+Re-designed as a plain process supervisor: torchelastic's WorkerGroup
+machinery is replaced by direct subprocess management, because on TPU a
+re-mesh requires restarting worker *processes* anyway
+(``jax.distributed`` cannot re-initialize in-process).
+
+Run states per monitor tick:
+- all workers exited 0     -> exit barrier, report success, done
+- any worker failed        -> breakpoint-save signal, restart-or-raise
+- membership change wanted -> graceful stop, new rendezvous, restart
+- otherwise                -> heartbeat (executing piggy-backed diagnosis
+                              actions), resource report
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.rendezvous import (
+    MasterRendezvousHandler,
+    RendezvousEvictedError,
+    RendezvousOutcome,
+    RendezvousTimeoutError,
+)
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    ExitCode,
+    GoodputPhase,
+    JobConstant,
+    NodeEnv,
+    NodeEventType,
+    RendezvousName,
+    TrainingExceptionLevel,
+    WorkerEnv,
+)
+from dlrover_tpu.common.env_utils import worker_env
+from dlrover_tpu.common.log import logger
+
+
+class RunResult(Enum):
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    RELAUNCH = "relaunch"  # ask the cluster layer for a new node
+
+
+@dataclass
+class WorkerSpec:
+    entrypoint: str  # path to the training script, or "-m module"
+    args: List[str] = field(default_factory=list)
+    nproc_per_node: int = 1
+    max_restarts: int = 3
+    node_rank: int = 0
+    node_unit: int = 1
+    rdzv_name: str = RendezvousName.TRAINING
+    join_timeout: float = 600.0
+    monitor_interval: float = 1.0
+    env: Dict[str, str] = field(default_factory=dict)
+    redirect_output: Optional[str] = None  # dir for per-worker logs
+
+
+@dataclass
+class _Worker:
+    local_rank: int
+    process: subprocess.Popen
+    log_file: Optional[object] = None
+
+
+class ElasticAgent:
+    """Supervises one node's worker processes across elastic restarts."""
+
+    def __init__(
+        self,
+        spec: WorkerSpec,
+        client: MasterClient,
+        ckpt_saver=None,
+    ):
+        self._spec = spec
+        self._client = client
+        self._rdzv = MasterRendezvousHandler(
+            client,
+            spec.node_rank,
+            spec.nproc_per_node,
+            rdzv_name=spec.rdzv_name,
+            node_unit=spec.node_unit,
+            join_timeout=spec.join_timeout,
+        )
+        self._workers: List[_Worker] = []
+        self._restart_count = 0
+        self._ckpt_saver = ckpt_saver
+        self._last_heartbeat = 0.0
+        self._last_resource_report = 0.0
+        self._current_outcome: Optional[RendezvousOutcome] = None
+        self._stopping = False
+
+    # ---- worker lifecycle --------------------------------------------------
+
+    def _initialize_workers(self) -> RendezvousOutcome:
+        rdzv_start = time.time()
+        outcome = self._rdzv.next_rendezvous()
+        self._client.report_goodput_phase(
+            GoodputPhase.RENDEZVOUS, rdzv_start, time.time()
+        )
+        self._current_outcome = outcome
+        if self._ckpt_saver is not None:
+            self._ckpt_saver.set_world(outcome.world)
+        self._start_workers(outcome)
+        return outcome
+
+    def _start_workers(self, outcome: RendezvousOutcome):
+        spec = self._spec
+        self._workers = []
+        # Workers must be able to import this framework even when the
+        # launcher was started from a different cwd/PYTHONPATH.
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        for local_rank in range(spec.nproc_per_node):
+            env = dict(os.environ)
+            existing = env.get("PYTHONPATH", "")
+            if pkg_root not in existing.split(os.pathsep):
+                env["PYTHONPATH"] = (
+                    f"{existing}{os.pathsep}{pkg_root}" if existing else pkg_root
+                )
+            env.update(spec.env)
+            env.update(
+                worker_env(
+                    coordinator=outcome.coordinator_address,
+                    num_processes=outcome.num_processes,
+                    process_id=outcome.process_id_base + local_rank,
+                    local_rank=local_rank,
+                    local_world_size=spec.nproc_per_node,
+                    restart_count=self._restart_count,
+                    rdzv_round=outcome.round,
+                )
+            )
+            if spec.entrypoint.startswith("-m "):
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    spec.entrypoint[3:].strip(),
+                    *spec.args,
+                ]
+            else:
+                cmd = [sys.executable, spec.entrypoint, *spec.args]
+            log_file = None
+            stdout = stderr = None
+            if spec.redirect_output:
+                os.makedirs(spec.redirect_output, exist_ok=True)
+                path = os.path.join(
+                    spec.redirect_output,
+                    f"worker-{spec.node_rank}-{local_rank}.log",
+                )
+                log_file = open(path, "ab")
+                stdout = stderr = log_file
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,
+            )
+            self._workers.append(_Worker(local_rank, proc, log_file))
+            logger.info(
+                "started worker local_rank=%d pid=%d process_id=%d",
+                local_rank,
+                proc.pid,
+                outcome.process_id_base + local_rank,
+            )
+
+    def _stop_workers(self, timeout: float = 15.0):
+        for w in self._workers:
+            if w.process.poll() is None:
+                try:
+                    os.killpg(w.process.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + timeout
+        for w in self._workers:
+            remaining = max(deadline - time.time(), 0.1)
+            try:
+                w.process.wait(remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(w.process.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                w.process.wait()
+        for w in self._workers:
+            if w.log_file:
+                w.log_file.close()
+                w.log_file = None
+
+    def _restart_workers(self):
+        restart_start = time.time()
+        self._stop_workers()
+        self._restart_count += 1
+        self._initialize_workers()
+        self._client.report_goodput_phase(
+            GoodputPhase.RESTART, restart_start, time.time()
+        )
+
+    # ---- monitoring --------------------------------------------------------
+
+    def _monitor_workers(self) -> Optional[str]:
+        """Return "succeeded"|"failed"|None (still running)."""
+        states = [w.process.poll() for w in self._workers]
+        if all(s == 0 for s in states):
+            return "succeeded"
+        if any(s is not None and s != 0 for s in states):
+            return "failed"
+        return None
+
+    def _failed_exit_codes(self) -> Dict[int, int]:
+        return {
+            w.local_rank: w.process.returncode
+            for w in self._workers
+            if w.process.poll() is not None and w.process.returncode != 0
+        }
+
+    def _membership_changed(self) -> bool:
+        return self._rdzv.num_nodes_waiting() > 0
+
+    def _heartbeat_and_actions(self) -> Optional[RunResult]:
+        try:
+            actions = self._client.report_heartbeat()
+        except Exception:
+            logger.warning("heartbeat failed", exc_info=True)
+            return None
+        for action in actions or []:
+            atype = getattr(action, "action_type", None)
+            if atype == DiagnosisActionType.RESTART_WORKER:
+                logger.info("diagnosis action: restart workers in place")
+                self._restart_workers()
+            elif atype == DiagnosisActionType.RELAUNCH_WORKER:
+                logger.info("diagnosis action: relaunch node")
+                self._stop_workers()
+                return RunResult.RELAUNCH
+            elif atype == DiagnosisActionType.JOB_ABORT:
+                logger.info("diagnosis action: abort job")
+                self._stop_workers()
+                return RunResult.FAILED
+            elif atype == DiagnosisActionType.JOB_RESTART:
+                logger.info("diagnosis action: job restart")
+                self._restart_workers()
+        return None
+
+    # ---- failure handling --------------------------------------------------
+
+    def _on_workers_failed(self) -> Optional[RunResult]:
+        codes = self._failed_exit_codes()
+        logger.warning("worker failure, exit codes %s", codes)
+        if self._ckpt_saver is not None:
+            try:
+                self._ckpt_saver.save_shm_on_failure()
+            except Exception:
+                logger.exception("breakpoint checkpoint save failed")
+        hardware_fault = any(
+            c in (ExitCode.HARDWARE_ERROR, ExitCode.GPU_DRIVER_ERROR)
+            for c in codes.values()
+        )
+        try:
+            self._client.report_failure(
+                error_data=str(codes),
+                node_rank=self._spec.node_rank,
+                restart_count=self._restart_count,
+                exit_code=next(iter(codes.values()), 1),
+                level=TrainingExceptionLevel.NODE_ERROR
+                if hardware_fault
+                else TrainingExceptionLevel.PROCESS_ERROR,
+            )
+        except Exception:
+            logger.warning("failure report failed", exc_info=True)
+        if hardware_fault:
+            return RunResult.RELAUNCH
+        if self._restart_count >= self._spec.max_restarts:
+            logger.error(
+                "max restarts (%d) exhausted", self._spec.max_restarts
+            )
+            return RunResult.FAILED
+        self._restart_workers()
+        return None
+
+    # ---- main loop ---------------------------------------------------------
+
+    def run(self) -> RunResult:
+        try:
+            return self._run()
+        except RendezvousEvictedError:
+            logger.warning("evicted from rendezvous; requesting relaunch")
+            self._stop_workers()
+            return RunResult.RELAUNCH
+        except RendezvousTimeoutError:
+            logger.error("rendezvous timed out; requesting relaunch")
+            self._stop_workers()
+            try:
+                self._client.report_failure(
+                    "rendezvous timeout",
+                    node_rank=self._spec.node_rank,
+                    restart_count=self._restart_count,
+                    level=TrainingExceptionLevel.RDZV_ERROR,
+                )
+            except Exception:
+                pass
+            return RunResult.RELAUNCH
+
+    def _run(self) -> RunResult:
+        spec = self._spec
+        self._initialize_workers()
+        while True:
+            time.sleep(spec.monitor_interval)
+            state = self._monitor_workers()
+            if state == "succeeded":
+                self._exit_barrier()
+                try:
+                    self._client.report_succeeded()
+                except Exception:
+                    logger.warning("success report failed", exc_info=True)
+                logger.info("all workers succeeded")
+                return RunResult.SUCCEEDED
+            if state == "failed":
+                result = self._on_workers_failed()
+                if result is not None:
+                    return result
+                continue
+            # healthy: heartbeat + membership check
+            now = time.time()
+            if now - self._last_heartbeat > JobConstant.NODE_HEARTBEAT_INTERVAL:
+                self._last_heartbeat = now
+                result = self._heartbeat_and_actions()
+                if result is not None:
+                    return result
+            if self._membership_changed():
+                logger.info(
+                    "membership change detected; gracefully re-meshing"
+                )
+                self._restart_workers()
+
+    def _exit_barrier(self, timeout: float = 300.0):
+        """All agents wait so slow savers/rank committers can finish.
+
+        Reference: training.py exit_barrier via master KV store. Implemented
+        with set+poll on per-node keys (idempotent under RPC retry, unlike a
+        counter)."""
+        outcome = self._current_outcome
+        if outcome is None or len(outcome.world) <= 1:
+            return
+        key = f"exit-barrier/{outcome.round}/{self._spec.node_rank}"
+        try:
+            self._client.kv_store_set(key, b"1")
+            peer_keys = [
+                f"exit-barrier/{outcome.round}/{r}" for r in outcome.world
+            ]
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                values = self._client.kv_store_multi_get(peer_keys)
+                if len(values) >= len(peer_keys):
+                    return
+                time.sleep(0.5)
+            logger.warning("exit barrier timed out")
+        except Exception:
+            logger.warning("exit barrier failed", exc_info=True)
+
+    def stop(self):
+        self._stopping = True
+        self._stop_workers()

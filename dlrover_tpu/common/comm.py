@@ -120,7 +120,13 @@ class FaultNodeRequest(BaseRequest):
 
 @dataclass
 class FaultNodeResponse(BaseResponse):
+    # Verdict of the last fully-reported check round; -1 while none has
+    # concluded (an empty fault list is only meaningful when
+    # evaluated_round >= 0). needs_round2 tells agents a suspect-bisection
+    # round is pending and they should rejoin the check rendezvous.
     fault_nodes: List[int] = field(default_factory=list)
+    evaluated_round: int = -1
+    needs_round2: bool = False
 
 
 @dataclass

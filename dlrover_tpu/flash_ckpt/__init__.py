@@ -1,0 +1,18 @@
+"""Flash checkpoint: JAX pytrees -> host shared memory in O(100ms), with
+asynchronous persistence, memory-first resume, and resharding restore.
+
+Parity: reference trainer/torch/flash_checkpoint/* +
+elastic_agent/torch/ckpt_saver.py, re-designed for JAX (SURVEY.md section 7):
+- the trainer writes device arrays into POSIX shared memory via
+  ``jax.device_get`` into preallocated buffers;
+- the agent process persists shm -> storage off the training critical path;
+- restore prefers shm (same-host restart) and falls back to storage;
+- the "universal checkpoint" re-parallelization of the reference collapses
+  to metadata: global shape + sharding per leaf lets any new mesh load via
+  ``jax.make_array_from_process_local_data``.
+"""
+
+from dlrover_tpu.flash_ckpt.checkpointer import (  # noqa: F401
+    Checkpointer,
+    StorageType,
+)

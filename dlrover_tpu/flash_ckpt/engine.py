@@ -1,0 +1,315 @@
+"""Trainer-side flash checkpoint engine.
+
+Parity: reference trainer/torch/flash_checkpoint/engine.py
+(CheckpointEngine.save_state_dict_to_memory:365,
+get_state_dict_from_memory:406) adapted to JAX pytrees: the blocking cost
+of a save is one ``jax.device_get`` of the state into shared memory; the
+agent persists asynchronously. Restore is memory-first, storage-fallback,
+with resharding handled through shard metadata +
+``jax.make_array_from_callback`` under the *current* mesh.
+"""
+
+import os
+import queue
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import GoodputPhase, NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.flash_ckpt import storage as ckpt_storage
+from dlrover_tpu.flash_ckpt.shared_obj import (
+    SharedLockClient,
+    SharedQueueClient,
+)
+from dlrover_tpu.flash_ckpt.shm_handler import (
+    SharedMemoryHandler,
+    bounds_to_slices,
+)
+from dlrover_tpu.trainer.runtime import get_context
+
+CKPT_EVENT_QUEUE = "ckpt_event"
+CKPT_LOCK_PREFIX = "ckpt_shm"
+
+
+def shm_segment_name(local_rank: int) -> str:
+    job = os.getenv(NodeEnv.JOB_NAME, "job")
+    return f"dlrover_tpu_ckpt_{job}_{local_rank}"
+
+
+class SaveEvent:
+    SAVE_MEM = "save_mem"
+    SAVE_DISK = "save_disk"
+
+    def __init__(
+        self,
+        kind: str,
+        step: int,
+        checkpoint_dir: str = "",
+        local_world_size: int = 1,
+    ):
+        self.kind = kind
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+        self.local_world_size = local_world_size
+
+
+class CheckpointEngine:
+    """One instance per worker process."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        standalone: bool = False,
+    ):
+        """``standalone=True`` runs without an agent (no UDS servers): saves
+        go to shm and persistence happens synchronously in-process — used
+        for notebooks/tests and as a degraded mode."""
+        self.checkpoint_dir = checkpoint_dir
+        self._ctx = get_context()
+        self._local_rank = self._ctx.local_rank
+        self._shm = SharedMemoryHandler(shm_segment_name(self._local_rank))
+        self._standalone = standalone
+        if standalone:
+            self._lock = None
+            self._event_queue = None
+        else:
+            self._lock = SharedLockClient(
+                f"{CKPT_LOCK_PREFIX}_{self._local_rank}"
+            )
+            self._event_queue = SharedQueueClient(CKPT_EVENT_QUEUE)
+        self._last_save_time = 0.0
+        self._last_disk_step = -1  # newest step a disk save was requested for
+
+    # ---- save --------------------------------------------------------------
+
+    def save_to_memory(
+        self,
+        step: int,
+        state: Any,
+        user_meta: Optional[Dict[str, Any]] = None,
+    ) -> float:
+        """Blocking-path save: device -> shm. Returns block seconds."""
+        import jax
+
+        start = time.time()
+        jax.block_until_ready(state)
+        meta = dict(user_meta or {})
+        meta["process_id"] = self._ctx.process_id
+        meta["num_processes"] = self._ctx.num_processes
+        meta["local_rank"] = self._local_rank
+        if self._lock is not None:
+            self._lock.acquire()
+        try:
+            self._shm.save_state_dict(step, state, meta)
+        finally:
+            if self._lock is not None:
+                self._lock.release()
+        if self._event_queue is not None and self._local_rank == 0:
+            self._event_queue.put(
+                SaveEvent(
+                    SaveEvent.SAVE_MEM,
+                    step,
+                    self.checkpoint_dir,
+                    self._ctx.local_world_size,
+                )
+            )
+        elapsed = time.time() - start
+        self._last_save_time = time.time()
+        logger.info(
+            "flash ckpt step %d -> shm in %.3fs", step, elapsed
+        )
+        return elapsed
+
+    def save_to_storage(
+        self,
+        step: int,
+        state: Any,
+        user_meta: Optional[Dict[str, Any]] = None,
+    ) -> float:
+        """Save to shm, then request async persistence to storage."""
+        elapsed = self.save_to_memory(step, state, user_meta)
+        self._last_disk_step = step
+        if self._standalone:
+            self._persist_in_process(step)
+        elif self._local_rank == 0:
+            self._event_queue.put(
+                SaveEvent(
+                    SaveEvent.SAVE_DISK,
+                    step,
+                    self.checkpoint_dir,
+                    self._ctx.local_world_size,
+                )
+            )
+        return elapsed
+
+    def _persist_in_process(self, step: int):
+        from dlrover_tpu.flash_ckpt.saver import persist_shm_to_storage
+
+        node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        persist_shm_to_storage(
+            self.checkpoint_dir,
+            step,
+            node_rank,
+            local_world_size=self._ctx.local_world_size,
+            expected_nodes=[node_rank],
+        )
+
+    # ---- load --------------------------------------------------------------
+
+    def load(self, step: Optional[int] = None):
+        """Return (step, np-pytree, user_meta) or None.
+
+        Memory-first: the shm image survives worker restarts on the same
+        host. Falls back to the committed storage checkpoint.
+        """
+        result = self._load_from_memory(step)
+        if result is not None:
+            logger.info("restored step %d from host memory", result[0])
+            return result
+        result = self._load_from_storage(step)
+        if result is not None:
+            logger.info("restored step %d from storage", result[0])
+        return result
+
+    def _load_from_memory(self, step: Optional[int] = None):
+        mem_step = self._shm.get_step()
+        if mem_step < 0 or (step is not None and mem_step != step):
+            return None
+        loaded = self._shm.load_state_dict()
+        if loaded is None:
+            return None
+        mem_step, state, meta = loaded
+        if meta.get("num_processes") != self._ctx.num_processes:
+            # World changed: per-process shm images do not cover the same
+            # index set; storage has the complete picture.
+            return None
+        state = assemble_sharded_leaves(state)
+        if state is None:
+            return None
+        return mem_step, state, meta
+
+    def _load_from_storage(self, step: Optional[int] = None):
+        target = step
+        if target is None:
+            target = ckpt_storage.read_tracker(self.checkpoint_dir)
+        if target < 0:
+            return None
+        metas = ckpt_storage.load_step_meta(self.checkpoint_dir, target)
+        if not metas:
+            return None
+        return load_global_state(self.checkpoint_dir, target, metas)
+
+    def latest_step(self) -> int:
+        """Newest restorable step (max of shm image and storage tracker)."""
+        return max(
+            self._shm.get_step(),
+            ckpt_storage.read_tracker(self.checkpoint_dir),
+        )
+
+    def close(self):
+        self._shm.close()
+
+
+# --------------------------------------------------------------------------
+# Reassembly helpers
+# --------------------------------------------------------------------------
+
+
+def assemble_sharded_leaves(state):
+    """Convert {"__shards__": ...} leaf records into full numpy arrays.
+
+    Returns None if any leaf's shards don't cover its global shape (the
+    caller must then use storage, which has every process's shards).
+    """
+    import jax
+
+    incomplete = []
+
+    def fix(leaf):
+        if not (isinstance(leaf, dict) and "__shards__" in leaf):
+            return leaf
+        assembled = _assemble_from_shards(
+            leaf["__global_shape__"], leaf["__dtype__"], leaf["__shards__"]
+        )
+        if assembled is None:
+            incomplete.append(leaf["__global_shape__"])
+        return assembled
+
+    is_record = lambda x: isinstance(x, dict) and "__shards__" in x  # noqa: E731
+    out = jax.tree_util.tree_map(fix, state, is_leaf=is_record)
+    if incomplete:
+        return None
+    return out
+
+
+def _assemble_from_shards(global_shape, dtype_name, shards):
+    from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
+
+    dtype = _np_dtype(dtype_name)
+    out = np.zeros(global_shape, dtype=dtype)
+    covered = np.zeros(global_shape, dtype=bool) if global_shape else None
+    for bounds, arr in shards:
+        slices = bounds_to_slices(bounds)
+        out[slices] = arr
+        if covered is not None:
+            covered[slices] = True
+    if covered is not None and not covered.all():
+        return None
+    return out
+
+
+def load_global_state(checkpoint_dir: str, step: int, metas: Dict[int, dict]):
+    """Assemble the full global state from every process's shard files."""
+    import pickle
+
+    import jax
+
+    from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
+
+    first = metas[min(metas)]
+    treedef = pickle.loads(first["treedef"])
+    num_leaves = len(first["leaves"])
+    leaves = [None] * num_leaves
+    user_meta = first.get("user_meta", {})
+    for pid, meta in sorted(metas.items()):
+        arrays = ckpt_storage.load_proc_arrays(checkpoint_dir, step, pid)
+        if arrays is None:
+            continue
+        for leaf_meta in meta["leaves"]:
+            i = leaf_meta.leaf_id
+            dtype = _np_dtype(leaf_meta.dtype)
+            if leaves[i] is None:
+                leaves[i] = np.zeros(leaf_meta.global_shape, dtype=dtype)
+            for j, shard in enumerate(leaf_meta.shards):
+                key = f"leaf{i}_shard{j}"
+                if key in arrays:
+                    slices = bounds_to_slices(shard.index)
+                    leaves[i][slices] = arrays[key]
+    if any(l is None for l in leaves):
+        return None
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return step, state, user_meta
+
+
+def to_device_state(np_state, sharding_tree=None):
+    """Put a numpy pytree onto devices under the current mesh.
+
+    sharding_tree: matching pytree of ``jax.sharding.Sharding`` (or None
+    for single-device default placement). Uses make_array_from_callback so
+    each process materializes only its addressable shards — the resharding
+    restore path ("universal checkpoint" analogue).
+    """
+    import jax
+
+    if sharding_tree is None:
+        return jax.tree_util.tree_map(jax.numpy.asarray, np_state)
+
+    def put(arr, sharding):
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(put, np_state, sharding_tree)

@@ -1,0 +1,300 @@
+"""Agent-resident async checkpoint saver.
+
+Parity: reference elastic_agent/torch/ckpt_saver.py (AsyncCheckpointSaver:
+399, _sync_shm_to_storage:619, commit_checkpoint:1029, save-on-failure
+:581). The saver lives in the AGENT process so a dying worker cannot take
+the persistence thread with it; shm segments likewise outlive workers.
+
+Commit protocol (crash-safe):
+1. every node writes its proc files + a ``node-<rank>.done`` marker into
+   the step dir (all writes are tmp+rename);
+2. the leader node (lowest rank in the world) polls until every expected
+   marker exists, then atomically replaces the tracker file and reports
+   the committed step to the master;
+3. a leader dying mid-commit is safe: markers persist, any relaunched
+   leader re-runs step 2 idempotently; an uncommitted step dir is garbage-
+   collected by the deletion strategy.
+"""
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.flash_ckpt import storage as ckpt_storage
+from dlrover_tpu.flash_ckpt.engine import (
+    CKPT_EVENT_QUEUE,
+    CKPT_LOCK_PREFIX,
+    SaveEvent,
+    shm_segment_name,
+)
+from dlrover_tpu.flash_ckpt.shared_obj import (
+    SharedDictServer,
+    SharedLockServer,
+    SharedQueueServer,
+)
+from dlrover_tpu.flash_ckpt.shm_handler import SharedMemoryHandler
+
+_MAX_LOCAL_WORKERS = 16
+
+
+def read_shm_payload(local_rank: int, lock=None):
+    """Extract (step, proc_payload) from a local worker's shm image.
+
+    Data is COPIED out while holding ``lock`` (the same SharedLock the
+    worker's engine takes while writing), so a concurrent next-step save
+    cannot tear the payload; the lock is released before any disk IO.
+    """
+    import numpy as np
+
+    if lock is not None:
+        lock.acquire()
+    try:
+        handler = SharedMemoryHandler(shm_segment_name(local_rank))
+        meta = handler.load_meta()
+        if meta is None:
+            handler.close()
+            return None
+        from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
+
+        buf = handler._shm.buf  # noqa: SLF001
+        data_start = meta["data_start"]
+        arrays = {}
+        for leaf_meta in meta["leaves"]:
+            dtype = _np_dtype(leaf_meta.dtype)
+            for j, shard in enumerate(leaf_meta.shards):
+                view = np.ndarray(
+                    shard.local_shape,
+                    dtype=dtype,
+                    buffer=buf,
+                    offset=data_start + shard.offset,
+                )
+                arrays[f"leaf{leaf_meta.leaf_id}_shard{j}"] = np.array(view)
+        step = meta["step"]
+        payload = {
+            "arrays": arrays,
+            "meta": {
+                "treedef": meta["treedef"],
+                "leaves": meta["leaves"],
+                "user_meta": meta.get("user_meta", {}),
+            },
+        }
+        handler.close()
+        return step, payload
+    finally:
+        if lock is not None:
+            lock.release()
+
+
+def persist_shm_to_storage(
+    checkpoint_dir: str,
+    step: int,
+    node_rank: int,
+    local_world_size: int,
+    expected_nodes: List[int],
+    master_client=None,
+    commit_timeout: float = 600.0,
+    max_to_keep: int = 3,
+    locks: Optional[list] = None,
+) -> bool:
+    """Persist this node's shm images for ``step`` and run the commit.
+
+    Aborts (returns False) if local ranks hold images of different steps —
+    a step dir must never mix shards from different training steps.
+    """
+    proc_payloads: Dict[int, dict] = {}
+    common_step: Optional[int] = None
+    for local_rank in range(local_world_size):
+        lock = locks[local_rank] if locks else None
+        result = read_shm_payload(local_rank, lock)
+        if result is None:
+            logger.warning(
+                "no shm image for local rank %d; aborting persist",
+                local_rank,
+            )
+            return False
+        shm_step, payload = result
+        if common_step is None:
+            common_step = shm_step
+        elif shm_step != common_step:
+            logger.error(
+                "local ranks hold mixed steps (%d vs %d); aborting persist "
+                "to avoid committing an inconsistent checkpoint",
+                common_step,
+                shm_step,
+            )
+            return False
+        process_id = payload["meta"]["user_meta"].get(
+            "process_id", local_rank
+        )
+        proc_payloads[process_id] = payload
+    if common_step != step:
+        logger.warning(
+            "shm images hold step %d (requested %d); persisting step %d",
+            common_step,
+            step,
+            common_step,
+        )
+        step = common_step
+    ckpt_storage.persist_node_shards(
+        checkpoint_dir, step, node_rank, proc_payloads
+    )
+
+    # Commit (leader only).
+    leader = min(expected_nodes) if expected_nodes else node_rank
+    if node_rank != leader:
+        if master_client is not None:
+            try:
+                master_client.report_ckpt_step(step, committed=False)
+            except Exception:
+                pass
+        return True
+    deadline = time.time() + commit_timeout
+    while time.time() < deadline:
+        done = ckpt_storage.nodes_done(checkpoint_dir, step)
+        if set(done) >= set(expected_nodes):
+            ckpt_storage.write_tracker(checkpoint_dir, step)
+            ckpt_storage.KeepLatestDeletionStrategy(max_to_keep).clean_up(
+                checkpoint_dir
+            )
+            if master_client is not None:
+                try:
+                    master_client.report_ckpt_step(step, committed=True)
+                except Exception:
+                    pass
+            logger.info("checkpoint step %d committed", step)
+            return True
+        time.sleep(0.5)
+    logger.error("commit of step %d timed out waiting for %s", step,
+                 expected_nodes)
+    return False
+
+
+class AsyncCheckpointSaver:
+    """Hosted by the agent; singleton per agent process."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, client=None, local_world_size: int = _MAX_LOCAL_WORKERS):
+        self._client = client
+        self._node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        self._event_queue = SharedQueueServer(CKPT_EVENT_QUEUE)
+        self._locks = [
+            SharedLockServer(f"{CKPT_LOCK_PREFIX}_{r}")
+            for r in range(local_world_size)
+        ]
+        self._conf_dict = SharedDictServer("ckpt_conf")
+        self._world_nodes: List[int] = [self._node_rank]
+        self._latest_mem_event: Optional[SaveEvent] = None
+        self._last_persisted_step = -1
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._saver_loop, daemon=True, name="ckpt-saver"
+        )
+        self._thread.start()
+
+    # ---- agent wiring ------------------------------------------------------
+
+    @classmethod
+    def start_async_saving_ckpt(
+        cls, client=None
+    ) -> "AsyncCheckpointSaver":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(client=client)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+                cls._instance = None
+
+    def set_world(self, world: Dict[int, int]):
+        """Called by the agent after each rendezvous round."""
+        self._world_nodes = sorted(world) if world else [self._node_rank]
+
+    # ---- saver loop --------------------------------------------------------
+
+    def _saver_loop(self):
+        while not self._stopped.is_set():
+            try:
+                event = self._event_queue.get(timeout=1.0)
+            except Exception:
+                continue
+            try:
+                self._handle_event(event)
+            except Exception:
+                logger.exception("checkpoint event handling failed")
+
+    def _handle_event(self, event: SaveEvent):
+        if event.kind == SaveEvent.SAVE_MEM:
+            self._latest_mem_event = event
+            return
+        if event.kind == SaveEvent.SAVE_DISK:
+            self._latest_mem_event = event
+            ok = persist_shm_to_storage(
+                event.checkpoint_dir,
+                event.step,
+                self._node_rank,
+                event.local_world_size,
+                self._world_nodes,
+                master_client=self._client,
+                locks=self._locks,
+            )
+            if ok:
+                self._last_persisted_step = event.step
+
+    # ---- failure path ------------------------------------------------------
+
+    def save_shm_on_failure(self):
+        """Breakpoint save: persist the newest shm image before restart.
+
+        Parity: reference _save_shm_before_exiting / agent
+        _save_ckpt_to_storage (training.py:1533)."""
+        event = self._latest_mem_event
+        if event is None:
+            return
+        newest = -1
+        for r in range(event.local_world_size):
+            h = SharedMemoryHandler(shm_segment_name(r))
+            newest = max(newest, h.get_step())
+            h.close()
+        if newest <= self._last_persisted_step or newest < 0:
+            return
+        tracker = ckpt_storage.read_tracker(event.checkpoint_dir)
+        if newest <= tracker:
+            return
+        logger.info("breakpoint-saving shm step %d to storage", newest)
+        ok = persist_shm_to_storage(
+            event.checkpoint_dir,
+            newest,
+            self._node_rank,
+            event.local_world_size,
+            # A failure save must not block on dead peers: commit with
+            # whatever nodes finish; the tracker only advances if all
+            # expected markers appear, so use just this node when alone.
+            self._world_nodes,
+            master_client=self._client,
+            commit_timeout=60.0,
+            locks=self._locks,
+        )
+        if ok:
+            self._last_persisted_step = newest
+
+    # ---- cleanup -----------------------------------------------------------
+
+    def unlink_all(self, local_world_size: int = _MAX_LOCAL_WORKERS):
+        for r in range(local_world_size):
+            SharedMemoryHandler(shm_segment_name(r)).unlink()
+
+    def stop(self):
+        self._stopped.set()
+        self._event_queue.stop()
+        for lock in self._locks:
+            lock.stop()
+        self._conf_dict.stop()

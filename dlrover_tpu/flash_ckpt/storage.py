@@ -1,0 +1,215 @@
+"""Checkpoint storage backends + on-disk layout.
+
+Parity: reference dlrover/python/common/storage.py (CheckpointStorage,
+PosixDiskStorage) and the commit protocol of ckpt_saver.py:914-1078
+(step dirs, done markers, rank0 atomic tracker update).
+
+Layout under ``checkpoint_dir``:
+
+    checkpoint-<step>/
+        proc-<process_id>.npz     # leaf shards written by that process
+        proc-<process_id>.meta    # pickled shard metadata
+        .done/node-<rank>.done    # per-node completion markers
+    latest_checkpointed_iteration.txt   # tracker, atomically replaced
+"""
+
+import os
+import pickle
+import shutil
+import tempfile
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import logger
+
+
+class CheckpointStorage(ABC):
+    @abstractmethod
+    def write(self, content: bytes, path: str):
+        ...
+
+    @abstractmethod
+    def read(self, path: str) -> Optional[bytes]:
+        ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool:
+        ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]:
+        ...
+
+    @abstractmethod
+    def makedirs(self, path: str):
+        ...
+
+    @abstractmethod
+    def remove(self, path: str):
+        ...
+
+
+class PosixDiskStorage(CheckpointStorage):
+    def write(self, content: bytes, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, path: str) -> Optional[bytes]:
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return os.listdir(path) if os.path.isdir(path) else []
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+
+def step_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(
+        checkpoint_dir, f"{CheckpointConstant.STEP_DIR_PREFIX}{step}"
+    )
+
+
+def tracker_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, CheckpointConstant.TRACKER_FILE)
+
+
+def read_tracker(checkpoint_dir: str) -> int:
+    path = tracker_path(checkpoint_dir)
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return -1
+
+
+def write_tracker(checkpoint_dir: str, step: int):
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    tmp = tracker_path(checkpoint_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(step))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, tracker_path(checkpoint_dir))
+
+
+def persist_node_shards(
+    checkpoint_dir: str,
+    step: int,
+    node_rank: int,
+    proc_payloads: Dict[int, dict],
+):
+    """Write one node's processes' shard files + its done marker.
+
+    proc_payloads: process_id -> {"arrays": {name: np.ndarray},
+    "meta": picklable}.
+    """
+    sdir = step_dir(checkpoint_dir, step)
+    os.makedirs(sdir, exist_ok=True)
+    for process_id, payload in proc_payloads.items():
+        npz_tmp = os.path.join(sdir, f".proc-{process_id}.npz.tmp")
+        with open(npz_tmp, "wb") as f:
+            np.savez(f, **payload["arrays"])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(npz_tmp, os.path.join(sdir, f"proc-{process_id}.npz"))
+        meta_tmp = os.path.join(sdir, f".proc-{process_id}.meta.tmp")
+        with open(meta_tmp, "wb") as f:
+            pickle.dump(payload["meta"], f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(meta_tmp, os.path.join(sdir, f"proc-{process_id}.meta"))
+    done_dir = os.path.join(sdir, CheckpointConstant.DONE_DIR)
+    os.makedirs(done_dir, exist_ok=True)
+    done_tmp = os.path.join(done_dir, f".node-{node_rank}.tmp")
+    with open(done_tmp, "w") as f:
+        f.write("1")
+    os.replace(done_tmp, os.path.join(done_dir, f"node-{node_rank}.done"))
+
+
+def nodes_done(checkpoint_dir: str, step: int) -> List[int]:
+    done_dir = os.path.join(
+        step_dir(checkpoint_dir, step), CheckpointConstant.DONE_DIR
+    )
+    ranks = []
+    if os.path.isdir(done_dir):
+        for name in os.listdir(done_dir):
+            if name.startswith("node-") and name.endswith(".done"):
+                try:
+                    ranks.append(int(name[5:-5]))
+                except ValueError:
+                    pass
+    return sorted(ranks)
+
+
+def load_step_meta(checkpoint_dir: str, step: int) -> Dict[int, dict]:
+    """process_id -> meta for every proc file present."""
+    sdir = step_dir(checkpoint_dir, step)
+    metas: Dict[int, dict] = {}
+    if not os.path.isdir(sdir):
+        return metas
+    for name in os.listdir(sdir):
+        if name.startswith("proc-") and name.endswith(".meta"):
+            pid = int(name[5:-5])
+            with open(os.path.join(sdir, name), "rb") as f:
+                metas[pid] = pickle.load(f)
+    return metas
+
+
+def load_proc_arrays(checkpoint_dir: str, step: int, process_id: int):
+    path = os.path.join(step_dir(checkpoint_dir, step), f"proc-{process_id}.npz")
+    if not os.path.exists(path):
+        return None
+    return np.load(path, allow_pickle=False)
+
+
+def list_step_dirs(checkpoint_dir: str) -> List[int]:
+    steps = []
+    if os.path.isdir(checkpoint_dir):
+        for name in os.listdir(checkpoint_dir):
+            if name.startswith(CheckpointConstant.STEP_DIR_PREFIX):
+                try:
+                    steps.append(
+                        int(name[len(CheckpointConstant.STEP_DIR_PREFIX):])
+                    )
+                except ValueError:
+                    pass
+    return sorted(steps)
+
+
+class KeepLatestDeletionStrategy:
+    """Retain the newest ``max_to_keep`` step dirs (reference
+    storage.py deletion strategies)."""
+
+    def __init__(self, max_to_keep: int = 3):
+        self.max_to_keep = max_to_keep
+
+    def clean_up(self, checkpoint_dir: str):
+        steps = list_step_dirs(checkpoint_dir)
+        committed = read_tracker(checkpoint_dir)
+        victims = [s for s in steps if s != committed][: -self.max_to_keep]
+        for s in victims:
+            if s == committed:
+                continue
+            logger.info("removing old checkpoint step %d", s)
+            shutil.rmtree(step_dir(checkpoint_dir, s), ignore_errors=True)

@@ -227,9 +227,35 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_status: Dict[int, bool] = {}
         self._node_groups: List[Dict[int, int]] = []
         self._check_round = 0
-        self._fault_nodes: Set[int] = set()
         self._stragglers: Set[int] = set()
         self._reported: Dict[int, float] = {}
+        # check_round -> evaluated fault list (evaluation happens eagerly
+        # when the last report of a round arrives, so agents can poll for
+        # a round's verdict without racing the round transition).
+        self._eval_results: Dict[int, List[int]] = {}
+
+    def _check_concluded(self) -> bool:
+        """Final verdict reached: round 0 clean, or round 1 evaluated."""
+        return (
+            self._check_round == 0 and 0 in self._eval_results
+        ) or 1 in self._eval_results
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        node_rank: int,
+        local_world_size: int,
+        node_ip: str = "",
+    ) -> int:
+        # A join after a concluded check starts a FRESH check cycle
+        # (e.g. a relaunched node re-running its health probes, or a
+        # scheduled re-check); stale verdicts must not leak into it.
+        with self._lock:
+            if self._check_concluded():
+                self._reset_check_locked()
+        return super().join_rendezvous(
+            node_id, node_rank, local_world_size, node_ip
+        )
 
     def get_comm_world(self, node_rank: int):
         with self._lock:
@@ -307,54 +333,80 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             # Round 0: failure marks the node suspect. Round 1: the verdict
             # of the suspect+healthy pairing is final for this node.
             self._node_status[node_rank] = succeeded
+            self._maybe_evaluate_round()
 
-    def check_fault_node(self) -> Tuple[List[int], int]:
-        """Return (fault_nodes, reason_round) once all reports are in."""
+    def _maybe_evaluate_round(self):
+        """Evaluate the current check round once every node reported."""
+        expected = set(self._latest_world)
+        if not expected or not (set(self._reported) >= expected):
+            return
+        if self._check_round in self._eval_results:
+            return
+        suspects = {r for r, ok in self._node_status.items() if not ok}
+        self._evaluate_stragglers()  # only ever on a COMPLETE report set
+        if self._check_round == 0 and suspects:
+            # bisection round needed; no verdict yet
+            self._eval_results[0] = []
+            self._check_round = 1
+            self._node_groups = []  # force suspect+healthy regrouping
+            self._reported = {}
+            logger.info(
+                "network check round 0: suspects %s; running verification "
+                "round",
+                sorted(suspects),
+            )
+        else:
+            self._eval_results[self._check_round] = sorted(suspects)
+            logger.info(
+                "network check round %d verdict: faults=%s",
+                self._check_round,
+                sorted(suspects),
+            )
+
+    def check_fault_node(self) -> Tuple[List[int], int, bool]:
+        """Return (faults_of_last_evaluated_round, last_evaluated_round,
+        needs_round2). last_evaluated_round == -1 while nothing concluded."""
         with self._lock:
-            expected = set(self._latest_world)
-            if expected and set(self._reported) >= expected:
-                if self._check_round == 0:
-                    suspects = {
-                        r for r, ok in self._node_status.items() if not ok
-                    }
-                    if suspects:
-                        self._check_round = 1
-                        # Force regrouping (suspect+healthy pairs) on the
-                        # next rendezvous round.
-                        self._node_groups = []
-                    self._fault_nodes = set()
-                else:
-                    self._fault_nodes = {
-                        r for r, ok in self._node_status.items() if not ok
-                    }
-            return sorted(self._fault_nodes), self._check_round
+            if not self._eval_results:
+                return [], -1, False
+            last = max(self._eval_results)
+            needs_round2 = self._check_round == 1 and 1 not in self._eval_results
+            return list(self._eval_results[last]), last, needs_round2
+
+    def _evaluate_stragglers(self):
+        """Called under self._lock, ONLY when a round's reports are
+        complete — a partial report set would produce false positives.
+        Replace (not accumulate) so a later full round corrects earlier
+        transients."""
+        times = {
+            r: t
+            for r, t in self._reported.items()
+            if not math.isinf(t) and t > 0
+        }
+        if len(times) < 2:
+            return
+        med = statistics.median(times.values())
+        if med <= 0:
+            return
+        ratio = NetworkCheckConstant.STRAGGLER_RATIO
+        self._stragglers = {r for r, t in times.items() if t > ratio * med}
 
     def check_straggler(self) -> List[int]:
         with self._lock:
-            times = {
-                r: t
-                for r, t in self._reported.items()
-                if not math.isinf(t) and t > 0
-            }
-            if len(times) < 2:
-                return []
-            med = statistics.median(times.values())
-            if med <= 0:
-                return []
-            ratio = NetworkCheckConstant.STRAGGLER_RATIO
-            self._stragglers = {
-                r for r, t in times.items() if t > ratio * med
-            }
             return sorted(self._stragglers)
 
     def reset_check(self):
         with self._lock:
-            self._check_round = 0
-            self._node_status.clear()
-            self._node_groups = []
-            self._fault_nodes.clear()
-            self._stragglers.clear()
-            self._reported.clear()
+            self._reset_check_locked()
+
+    def _reset_check_locked(self):
+        self._check_round = 0
+        self._node_status.clear()
+        self._node_groups = []
+        self._latest_world = {}
+        self._stragglers.clear()
+        self._reported.clear()
+        self._eval_results.clear()
 
 
 def create_rdzv_managers() -> Dict[str, RendezvousManager]:

@@ -173,8 +173,12 @@ class MasterServicer(MasterService):
     def _get_fault_nodes(self, msg, req):
         mgr = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         if isinstance(mgr, NetworkCheckRendezvousManager):
-            nodes, _ = mgr.check_fault_node()
-            return comm.FaultNodeResponse(fault_nodes=nodes)
+            nodes, evaluated_round, needs_round2 = mgr.check_fault_node()
+            return comm.FaultNodeResponse(
+                fault_nodes=nodes,
+                evaluated_round=evaluated_round,
+                needs_round2=needs_round2,
+            )
         return comm.FaultNodeResponse()
 
     def _get_stragglers(self, msg, req):

@@ -1,0 +1,233 @@
+"""``python -m dlrover_tpu.run`` — the elastic launcher CLI.
+
+Parity: reference dlrover/trainer/torch/elastic_run.py (``dlrover-run``):
+a torchrun-superset that (a) bootstraps a local master in standalone mode,
+(b) merges master-pushed config, (c) gates on pre-check, then hands off to
+the elastic agent. Here the launched workers are JAX processes.
+
+Usage:
+    python -m dlrover_tpu.run --standalone --nproc_per_node 1 train.py ...
+    python -m dlrover_tpu.run --master host:port --nnodes 2:4 train.py ...
+"""
+
+import argparse
+import atexit
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor import ResourceMonitor
+from dlrover_tpu.agent.training import ElasticAgent, RunResult, WorkerSpec
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    PreCheckStatus,
+)
+from dlrover_tpu.common.env_utils import get_env_int
+from dlrover_tpu.common.log import logger
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dlrover-tpu-run", description="elastic JAX process launcher"
+    )
+    p.add_argument("--standalone", action="store_true", default=False)
+    p.add_argument("--master", type=str, default="", help="master addr host:port")
+    p.add_argument("--nnodes", type=str, default="1", help="N or MIN:MAX")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=-1)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--node_unit", type=int, default=1)
+    p.add_argument("--rdzv_join_timeout", type=float, default=600.0)
+    p.add_argument("--monitor_interval", type=float, default=1.0)
+    p.add_argument(
+        "--network-check",
+        action="store_true",
+        default=False,
+        help="run node/ICI health probes before training",
+    )
+    p.add_argument(
+        "--comm-perf-test",
+        action="store_true",
+        default=False,
+        help="include bandwidth benchmarks in the network check",
+    )
+    p.add_argument("--log_dir", type=str, default="")
+    p.add_argument("--pre_check_timeout", type=float, default=600.0)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
+    """Standalone bootstrap (reference elastic_run.py:326
+    _launch_dlrover_local_master)."""
+    port_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_tpu_"), "master_port"
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--platform",
+            "local",
+            "--node_num",
+            str(node_num),
+            "--port_file",
+            port_file,
+        ],
+        start_new_session=True,
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            port = open(port_file).read().strip()
+            if port:
+                return proc, f"127.0.0.1:{port}"
+        if proc.poll() is not None:
+            raise RuntimeError("local master exited during startup")
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("local master did not publish its port in 60s")
+
+
+def wait_pre_check(client: MasterClient, timeout: float):
+    """Gate on master pre-check (reference elastic_run.py:295)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            status = client.get_pre_check_result()
+        except Exception:
+            time.sleep(1)
+            continue
+        if status in (PreCheckStatus.PASS, PreCheckStatus.DISABLED):
+            return
+        if status == PreCheckStatus.FAIL:
+            raise SystemExit("master pre-check failed; aborting launch")
+        time.sleep(2)
+    raise SystemExit("timed out waiting for master pre-check")
+
+
+def _merge_master_config(client: MasterClient, args):
+    """Master-pushed config overrides CLI defaults (reference
+    elastic_run.py:438 _merge_elastic_config_from_master)."""
+    try:
+        config = client.get_elastic_run_config()
+    except Exception:
+        return
+    if "network_check" in config:
+        args.network_check = config["network_check"].lower() == "true"
+    if "max_restarts" in config:
+        args.max_restarts = int(config["max_restarts"])
+    if "monitor_interval" in config:
+        args.monitor_interval = float(config["monitor_interval"])
+
+
+def run(args) -> int:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    master_proc: Optional[subprocess.Popen] = None
+
+    node_rank = args.node_rank
+    if node_rank < 0:
+        node_rank = get_env_int(NodeEnv.NODE_RANK, 0)
+
+    if args.standalone and not args.master:
+        master_proc, master_addr = _launch_local_master(max_nodes)
+
+        def _cleanup():
+            if master_proc.poll() is None:
+                master_proc.terminate()
+
+        atexit.register(_cleanup)
+    else:
+        master_addr = args.master or os.getenv(NodeEnv.MASTER_ADDR, "")
+        if not master_addr:
+            raise SystemExit(
+                "--master (or DLROVER_TPU_MASTER_ADDR) required unless "
+                "--standalone"
+            )
+
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+    os.environ[NodeEnv.NODE_RANK] = str(node_rank)
+    client = MasterClient(master_addr, node_id=node_rank)
+    if not client.wait_master_ready(60):
+        raise SystemExit(f"master at {master_addr} not reachable")
+
+    _merge_master_config(client, args)
+    wait_pre_check(client, args.pre_check_timeout)
+
+    if args.network_check:
+        from dlrover_tpu.agent.node_check import run_network_check
+
+        ok = run_network_check(
+            client,
+            node_rank=node_rank,
+            nproc_per_node=args.nproc_per_node,
+            comm_perf=args.comm_perf_test,
+        )
+        if not ok:
+            logger.error("node failed network check; exiting for relaunch")
+            return 3
+
+    monitor = ResourceMonitor(client)
+    monitor.start()
+
+    spec = WorkerSpec(
+        entrypoint=args.training_script,
+        args=list(args.training_script_args),
+        nproc_per_node=args.nproc_per_node,
+        max_restarts=args.max_restarts,
+        node_rank=node_rank,
+        node_unit=args.node_unit,
+        join_timeout=args.rdzv_join_timeout,
+        monitor_interval=args.monitor_interval,
+        redirect_output=args.log_dir or None,
+    )
+    from dlrover_tpu.flash_ckpt.saver import AsyncCheckpointSaver
+
+    saver = AsyncCheckpointSaver.start_async_saving_ckpt(client=client)
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+
+    def _signal_handler(signum, frame):
+        logger.info("launcher received signal %d; stopping workers", signum)
+        agent.stop()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _signal_handler)
+
+    result = agent.run()
+    monitor.stop()
+    if result == RunResult.SUCCEEDED:
+        code = 0
+    elif result == RunResult.RELAUNCH:
+        code = 3  # cluster layer replaces this node
+    else:
+        code = 1
+    if master_proc is not None:
+        try:
+            master_proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            master_proc.terminate()
+    return code
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Worker-facing training library: runtime init, elastic trainer, data."""
+
+from dlrover_tpu.trainer.runtime import (  # noqa: F401
+    DistributedContext,
+    init_distributed,
+    get_context,
+)

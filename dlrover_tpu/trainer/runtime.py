@@ -1,0 +1,98 @@
+"""Worker-process runtime: consume the agent's env and bring up JAX.
+
+The agent injects the ``jax.distributed.initialize`` triple (see
+dlrover_tpu.common.constants.WorkerEnv); a training script calls
+``init_distributed()`` first thing. Single-process worlds skip
+``jax.distributed`` entirely so local runs work on any backend.
+
+Parity note: replaces the reference's reliance on torchrun env
+(WORLD_SIZE/RANK/MASTER_ADDR, training.py:_initialize_workers) with JAX's
+coordination model.
+"""
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv, WorkerEnv
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class DistributedContext:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    local_rank: int
+    local_world_size: int
+    restart_count: int
+    rdzv_round: int
+    initialized_jax_distributed: bool = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+
+_context: Optional[DistributedContext] = None
+
+
+def read_worker_env() -> DistributedContext:
+    return DistributedContext(
+        coordinator_address=os.getenv(WorkerEnv.COORDINATOR_ADDRESS, ""),
+        num_processes=int(os.getenv(WorkerEnv.NUM_PROCESSES, "1")),
+        process_id=int(os.getenv(WorkerEnv.PROCESS_ID, "0")),
+        local_rank=int(os.getenv(WorkerEnv.LOCAL_RANK, "0")),
+        local_world_size=int(os.getenv(WorkerEnv.LOCAL_WORLD_SIZE, "1")),
+        restart_count=int(os.getenv(WorkerEnv.RESTART_COUNT, "0")),
+        rdzv_round=int(os.getenv(WorkerEnv.RDZV_ROUND, "0")),
+    )
+
+
+def init_distributed(timeout_secs: int = 300) -> DistributedContext:
+    """Initialize JAX multi-process coordination from agent-injected env.
+
+    Idempotent per process. Must be called before any other JAX API touches
+    the backend.
+    """
+    global _context
+    if _context is not None:
+        return _context
+    ctx = read_worker_env()
+    if ctx.num_processes > 1 and ctx.coordinator_address:
+        import jax
+
+        logger.info(
+            "jax.distributed.initialize(%s, num=%d, id=%d)",
+            ctx.coordinator_address,
+            ctx.num_processes,
+            ctx.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+            initialization_timeout=timeout_secs,
+        )
+        ctx.initialized_jax_distributed = True
+    _context = ctx
+    return ctx
+
+
+def get_context() -> DistributedContext:
+    if _context is None:
+        return init_distributed()
+    return _context
+
+
+def shutdown_distributed():
+    global _context
+    if _context is not None and _context.initialized_jax_distributed:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            logger.warning("jax.distributed.shutdown failed", exc_info=True)
+    _context = None

@@ -1,15 +1,24 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so all
 sharding paths (dp/fsdp/tp/pp/sp/ep) are exercised without TPU hardware.
 
-Must run before the first ``import jax`` anywhere in the test session.
+The container's sitecustomize imports jax at interpreter startup (TPU
+plugin registration), so env vars alone come too late — jax.config is
+updated directly as well.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

@@ -1,0 +1,159 @@
+"""Elastic agent e2e: real worker subprocesses under an in-process master.
+
+Mirrors the reference's agent test strategy
+(tests/test_elastic_training_agent.py: agent + in-process master servicer,
+no containers), plus a chaos case: SIGKILL a worker mid-training and
+assert recovery from the shm flash checkpoint.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import ElasticAgent, RunResult, WorkerSpec
+from dlrover_tpu.flash_ckpt.saver import AsyncCheckpointSaver
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+WORKER = os.path.join(os.path.dirname(__file__), "workers", "simple_train.py")
+
+
+@pytest.fixture()
+def env_isolation(monkeypatch, tmp_path):
+    job = f"agent_t{time.time_ns() % 1000000}"
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", job)
+    monkeypatch.setenv("DLROVER_TPU_SHARED_DIR", str(tmp_path / "uds"))
+    monkeypatch.setenv("DLROVER_TPU_NODE_RANK", "0")
+    yield tmp_path
+
+
+@pytest.fixture()
+def master(env_isolation):
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    JobContext.reset_singleton()
+    m = LocalJobMaster(port=0, node_num=1)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def saver_client(master):
+    client = MasterClient(f"localhost:{master.port}", node_id=0)
+    AsyncCheckpointSaver.reset()
+    saver = AsyncCheckpointSaver.start_async_saving_ckpt(client=client)
+    yield client, saver
+    saver.unlink_all(2)
+    AsyncCheckpointSaver.reset()
+
+
+def _spec(tmp_path, total=10, crash_at=-1, max_restarts=2):
+    out = str(tmp_path / "progress.txt")
+    ckpt_dir = str(tmp_path / "ckpt")
+    return (
+        WorkerSpec(
+            entrypoint=WORKER,
+            args=[str(total), out, ckpt_dir, str(crash_at)],
+            nproc_per_node=1,
+            max_restarts=max_restarts,
+            node_rank=0,
+            monitor_interval=0.2,
+        ),
+        out,
+    )
+
+
+def _read_progress(out):
+    if not os.path.exists(out):
+        return []
+    lines = []
+    for line in open(out):
+        pid, step, restart, w0 = line.split()
+        lines.append(
+            (
+                int(pid),
+                int(step),
+                int(restart.split("=")[1]),
+                float(w0.split("=")[1]),
+            )
+        )
+    return lines
+
+
+def test_agent_runs_to_success(master, saver_client, tmp_path):
+    client, saver = saver_client
+    spec, out = _spec(tmp_path, total=5)
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    assert agent.run() == RunResult.SUCCEEDED
+    progress = _read_progress(out)
+    assert [p[1] for p in progress] == [1, 2, 3, 4, 5]
+
+
+def test_agent_restarts_crashed_worker_and_resumes(
+    master, saver_client, tmp_path
+):
+    """Worker self-crashes at step 3; agent restarts; training resumes
+    from the flash checkpoint (not from zero) and completes."""
+    client, saver = saver_client
+    spec, out = _spec(tmp_path, total=8, crash_at=3)
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    assert agent.run() == RunResult.SUCCEEDED
+    progress = _read_progress(out)
+    steps = [p[1] for p in progress]
+    # first incarnation reached 3; second resumed at 4 (memory-first)
+    assert steps[:3] == [1, 2, 3]
+    assert steps[3] == 4, f"resume did not continue from ckpt: {steps}"
+    assert steps[-1] == 8
+    # state was restored, not recomputed: w0 equals the step count
+    for _, step, _, w0 in progress:
+        assert w0 == float(step)
+    # the restart was surfaced to the worker
+    assert any(r == 1 for _, _, r, _ in progress)
+
+
+def test_agent_sigkill_recovery(master, saver_client, tmp_path):
+    """External SIGKILL (preemption-style) mid-run; recovery via shm."""
+    client, saver = saver_client
+    spec, out = _spec(tmp_path, total=20)
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    result_box = {}
+
+    def run():
+        result_box["result"] = agent.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # wait for some progress, then kill the worker hard
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if len(_read_progress(out)) >= 3:
+            break
+        time.sleep(0.1)
+    assert agent._workers, "worker never started"
+    pid = agent._workers[0].process.pid
+    os.kill(pid, signal.SIGKILL)
+    t.join(timeout=60)
+    assert result_box.get("result") == RunResult.SUCCEEDED
+    progress = _read_progress(out)
+    steps = [p[1] for p in progress]
+    assert steps[-1] == 20
+    # the restarted incarnation resumed from the checkpoint, not step 1
+    restarted_steps = [s for _, s, r, _ in progress if r >= 1]
+    assert restarted_steps, f"no restarted incarnation in {progress}"
+    assert min(restarted_steps) > 1, "worker restarted from zero"
+    # state restored exactly: w0 always equals the step count
+    for _, step, _, w0 in progress:
+        assert w0 == float(step)
+
+
+def test_agent_gives_up_after_max_restarts(master, saver_client, tmp_path):
+    client, saver = saver_client
+    # crash_at triggers only on restart_count==0, so use a worker that
+    # always fails: total < crash_at never reached; instead crash at 1
+    spec, out = _spec(tmp_path, total=3, crash_at=1, max_restarts=0)
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    assert agent.run() == RunResult.FAILED

@@ -1,0 +1,171 @@
+"""Flash checkpoint tests: shm image, engine save/load, resharding restore,
+commit protocol. (Reference test model: trainer/tests/torch fsdp_ckpt_test,
+tests/test_ckpt_saver.py.)"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.flash_ckpt import storage as ckpt_storage
+from dlrover_tpu.flash_ckpt.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.flash_ckpt.engine import to_device_state
+from dlrover_tpu.flash_ckpt.saver import persist_shm_to_storage
+from dlrover_tpu.flash_ckpt.shm_handler import SharedMemoryHandler
+from dlrover_tpu.trainer import runtime
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime(monkeypatch, tmp_path):
+    """Isolate shm/uds names and reset the runtime context per test."""
+    runtime._context = None
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", f"t{os.getpid()}_{time.time_ns() % 100000}")
+    monkeypatch.setenv("DLROVER_TPU_SHARED_DIR", str(tmp_path / "uds"))
+    yield
+    runtime._context = None
+
+
+def _cleanup(ckpt: Checkpointer):
+    ckpt._engine._shm.unlink()
+    ckpt.close()
+
+
+def test_shm_handler_roundtrip():
+    h = SharedMemoryHandler(f"test_shm_{time.time_ns()}")
+    state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4), "step": np.int64(7)}
+    h.save_state_dict(5, state, {"tag": "x"})
+    step, loaded, meta = h.load_state_dict()
+    assert step == 5
+    assert meta["tag"] == "x"
+    np.testing.assert_array_equal(loaded["w"], state["w"])
+    assert loaded["step"] == 7
+    # overwrite with a bigger state grows the segment
+    big = {"w": np.ones((100, 100), dtype=np.float32)}
+    h.save_state_dict(6, big)
+    step, loaded, _ = h.load_state_dict()
+    assert step == 6 and loaded["w"].shape == (100, 100)
+    h.unlink()
+
+
+def test_memory_save_load_roundtrip(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), standalone=True)
+    state = {
+        "params": {"w": jnp.ones((8, 4)), "b": jnp.zeros((4,))},
+        "opt": {"mu": jnp.full((8, 4), 0.5)},
+    }
+    block = ckpt.save_checkpoint(3, state)
+    assert block < 5.0
+    result = ckpt.load_checkpoint()
+    assert result is not None
+    step, restored, meta = result
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.ones((8, 4))
+    )
+    _cleanup(ckpt)
+
+
+def test_disk_save_and_commit(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(ckpt_dir, standalone=True)
+    state = {"w": jnp.arange(16.0).reshape(4, 4)}
+    ckpt.save_checkpoint(10, state, StorageType.DISK)
+    assert ckpt_storage.read_tracker(ckpt_dir) == 10
+    # memory wiped (new process simulation): storage restore works
+    ckpt._engine._shm.unlink()
+    runtime._context = None
+    ckpt2 = Checkpointer(ckpt_dir, standalone=True)
+    step, restored, _ = ckpt2.load_checkpoint()
+    assert step == 10
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(16.0).reshape(4, 4)
+    )
+    _cleanup(ckpt2)
+    _cleanup(ckpt)
+
+
+def test_sharded_state_memory_roundtrip(tmp_path):
+    """FSDP-style sharded leaves survive the shm roundtrip on one process."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices).reshape(8), ("fsdp",))
+    sharding = NamedSharding(mesh, P("fsdp"))
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8), sharding)
+    state = {"w": w}
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), standalone=True)
+    ckpt.save_checkpoint(1, state)
+    step, restored, _ = ckpt.load_checkpoint(
+        sharding_tree={"w": sharding}
+    )
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(64.0).reshape(8, 8)
+    )
+    assert restored["w"].sharding == sharding
+    _cleanup(ckpt)
+
+
+def test_resharding_restore_from_storage(tmp_path):
+    """Save under one sharding, restore under a different mesh layout —
+    the reference needs DeepSpeed UCP conversion for this (training.py:1548);
+    here shard metadata makes it direct."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    devices = np.array(jax.devices())
+    mesh1 = Mesh(devices.reshape(8), ("x",))
+    s1 = NamedSharding(mesh1, P("x", None))
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8), s1)
+    ckpt = Checkpointer(ckpt_dir, standalone=True)
+    ckpt.save_checkpoint(2, {"w": w}, StorageType.DISK)
+    ckpt._engine._shm.unlink()
+    runtime._context = None
+    # new "world": 2x4 mesh, shard on second axis instead
+    mesh2 = Mesh(devices.reshape(2, 4), ("a", "b"))
+    s2 = NamedSharding(mesh2, P(None, "b"))
+    ckpt2 = Checkpointer(ckpt_dir, standalone=True)
+    step, restored, _ = ckpt2.load_checkpoint(sharding_tree={"w": s2})
+    assert step == 2
+    assert restored["w"].sharding == s2
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(64.0).reshape(8, 8)
+    )
+    _cleanup(ckpt2)
+    _cleanup(ckpt)
+
+
+def test_save_blocking_time_small_vs_state_size(tmp_path):
+    """The blocking cost is a host memcpy, far below any disk write."""
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), standalone=True)
+    state = {"w": jnp.ones((512, 512))}  # 1MB
+    t0 = ckpt.save_checkpoint(1, state)
+    t1 = ckpt.save_checkpoint(2, state)  # steady-state: no realloc
+    assert t1 < 1.0
+    _cleanup(ckpt)
+
+
+def test_commit_protocol_multi_node(tmp_path, monkeypatch):
+    """Leader only commits once all expected node markers exist."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(ckpt_dir, standalone=True)
+    ckpt.save_checkpoint(4, {"w": jnp.ones((4,))})
+    # persist as node 0 of a 2-node world: commit must time out (node 1
+    # never writes its marker)
+    ok = persist_shm_to_storage(
+        ckpt_dir, 4, node_rank=0, local_world_size=1,
+        expected_nodes=[0, 1], commit_timeout=1.0,
+    )
+    assert not ok
+    assert ckpt_storage.read_tracker(ckpt_dir) == -1
+    # node 1's marker appears -> leader commit succeeds
+    sdir = ckpt_storage.step_dir(ckpt_dir, 4)
+    done = os.path.join(sdir, "._" + "dlrover_ckpt_done")
+    ckpt_storage.persist_node_shards(ckpt_dir, 4, 1, {})
+    ok = persist_shm_to_storage(
+        ckpt_dir, 4, node_rank=0, local_world_size=1,
+        expected_nodes=[0, 1], commit_timeout=5.0,
+    )
+    assert ok
+    assert ckpt_storage.read_tracker(ckpt_dir) == 4
+    _cleanup(ckpt)

@@ -1,0 +1,45 @@
+"""Network/node check e2e on the virtual CPU backend: two agents probe in
+pairs against a real in-process master (reference: tests around
+NodeCheckElasticAgent + rdzv NETWORK_CHECK)."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.node_check import run_network_check
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.local_master import LocalJobMaster
+
+
+@pytest.fixture()
+def master(monkeypatch, tmp_path):
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    monkeypatch.setenv("DLROVER_TPU_SHARED_DIR", str(tmp_path / "uds"))
+    JobContext.reset_singleton()
+    m = LocalJobMaster(port=0, node_num=2)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def test_two_node_check_all_healthy(master):
+    results = {}
+
+    def check(rank):
+        client = MasterClient(f"localhost:{master.port}", node_id=rank)
+        results[rank] = run_network_check(
+            client, node_rank=rank, nproc_per_node=1, timeout=120
+        )
+
+    threads = [
+        threading.Thread(target=check, args=(r,), daemon=True)
+        for r in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert results == {0: True, 1: True}

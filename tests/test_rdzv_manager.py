@@ -85,8 +85,8 @@ def test_network_check_pair_grouping_and_fault_isolation():
     mgr.report_network_check_result(1, True, 1.0)
     mgr.report_network_check_result(2, False, math.inf)
     mgr.report_network_check_result(3, False, math.inf)
-    faults, check_round = mgr.check_fault_node()
-    assert faults == [] and check_round == 1  # suspects need round 1
+    faults, evaluated_round, needs_round2 = mgr.check_fault_node()
+    assert faults == [] and evaluated_round == 0 and needs_round2
     # round 1: suspects paired with healthy nodes
     for i in range(4):
         mgr.join_rendezvous(i, i, 1)
@@ -101,8 +101,8 @@ def test_network_check_pair_grouping_and_fault_isolation():
     mgr.report_network_check_result(1, True, 1.0)
     mgr.report_network_check_result(2, True, 1.1)
     mgr.report_network_check_result(3, False, math.inf)
-    faults, _ = mgr.check_fault_node()
-    assert faults == [3]
+    faults, evaluated_round, needs_round2 = mgr.check_fault_node()
+    assert faults == [3] and evaluated_round == 1 and not needs_round2
 
 
 def test_straggler_detection():
