@@ -1,0 +1,14 @@
+"""Model zoo: functional JAX models with logical-axis sharding metadata.
+
+Every model exposes ``init_params(config, rng) -> (params, logical_axes)``
+and ``forward(config, params, tokens, ...) -> (logits, aux)`` as pure
+functions — no framework Module state, so checkpointing, resharding, and
+pipelining operate on plain pytrees.
+"""
+
+from dlrover_tpu.models.llama import (  # noqa: F401
+    TpuLMConfig,
+    init_params,
+    forward,
+    loss_fn,
+)

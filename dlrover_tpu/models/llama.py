@@ -1,0 +1,351 @@
+"""TpuLM — the flagship decoder-only transformer (Llama-family shape:
+RMSNorm + RoPE + GQA + SwiGLU; optionally MoE every layer).
+
+Pure-functional: ``init_params`` returns (params, logical_axes) twin
+pytrees; ``forward`` is jit/pjit-safe with static shapes and scan-over-
+layers. Parallelism is declared, not coded: logical axes map to the
+(dp, ep, pp, sp, tp) mesh via parallel/sharding.py rules, giving FSDP
+(embed over dp), tensor parallel (heads/mlp/vocab over tp), pipeline
+(stage over pp via trainer/pipeline.py), sequence parallel (ring
+attention over sp), and expert parallel (expert over ep) from one model
+definition.
+
+The reference delegates all of this to torch frameworks (SURVEY.md
+section 2.9); here the model layer is first-class so the elastic/ckpt
+machinery has a real workload to supervise.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import moe as moe_lib
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.ops.norms import rms_norm
+from dlrover_tpu.ops.rope import apply_rope
+from dlrover_tpu.parallel.sharding import with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuLMConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 11008
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"          # compute dtype (params stay f32)
+    # MoE (n_experts > 0 makes every layer's MLP an expert layer)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # pipeline: layer stack is stored [stages, layers_per_stage, ...]
+    pp_stages: int = 1
+    num_microbatches: int = 1
+    remat: bool = True
+
+    @property
+    def layers_per_stage(self) -> int:
+        if self.n_layers % self.pp_stages:
+            raise ValueError(
+                f"n_layers {self.n_layers} % pp_stages {self.pp_stages} != 0"
+            )
+        return self.n_layers // self.pp_stages
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd ~= 6 * params)."""
+        return 6.0 * self.count_params()
+
+    def count_params(self) -> int:
+        d, hd = self.embed_dim, self.head_dim
+        attn = d * (self.n_heads + 2 * self.n_kv_heads) * hd
+        attn += self.n_heads * hd * d
+        if self.n_experts > 0:
+            mlp = 3 * d * self.mlp_dim * self.n_experts + d * self.n_experts
+        else:
+            mlp = 3 * d * self.mlp_dim
+        per_layer = attn + mlp + 2 * d
+        return (
+            self.n_layers * per_layer
+            + 2 * self.vocab_size * d
+            + d
+        )
+
+
+def tiny_config(**overrides) -> TpuLMConfig:
+    """A config small enough for CPU tests yet exercising every axis."""
+    defaults = dict(
+        vocab_size=256,
+        embed_dim=64,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        mlp_dim=128,
+        dtype="float32",
+    )
+    defaults.update(overrides)
+    return TpuLMConfig(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _layer_leading(config) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """Leading dims/axes of stacked layer params."""
+    if config.pp_stages > 1:
+        return (
+            (config.pp_stages, config.layers_per_stage),
+            ("stage", "layer"),
+        )
+    return ((config.n_layers,), ("layer",))
+
+
+def param_axes(config: TpuLMConfig) -> Dict[str, Any]:
+    """Logical-axis names per param leaf (static; no tracing needed)."""
+    lead_ax = _layer_leading(config)[1]
+    layer_axes = {
+        "attn_norm": lead_ax + ("norm",),
+        "wq": lead_ax + ("embed", "heads", "head_dim"),
+        "wk": lead_ax + ("embed", "kv_heads", "head_dim"),
+        "wv": lead_ax + ("embed", "kv_heads", "head_dim"),
+        "wo": lead_ax + ("heads", "head_dim", "embed"),
+        "mlp_norm": lead_ax + ("norm",),
+    }
+    if config.n_experts > 0:
+        layer_axes.update(
+            router=lead_ax + ("embed", "expert"),
+            w_gate=lead_ax + ("expert", "embed", "mlp"),
+            w_up=lead_ax + ("expert", "embed", "mlp"),
+            w_down=lead_ax + ("expert", "mlp", "embed"),
+        )
+    else:
+        layer_axes.update(
+            w_gate=lead_ax + ("embed", "mlp"),
+            w_up=lead_ax + ("embed", "mlp"),
+            w_down=lead_ax + ("mlp", "embed"),
+        )
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer_axes,
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(
+    config: TpuLMConfig, rng: jax.Array
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Returns (params, logical_axes): twin pytrees.
+
+    Simple init: normal(0, 1/sqrt(fan_in)); norm scales zero (the
+    (1+scale) parameterization makes zero the identity).
+    """
+    d, hd = config.embed_dim, config.head_dim
+    h, kv = config.n_heads, config.n_kv_heads
+    f, v = config.mlp_dim, config.vocab_size
+    lead, _ = _layer_leading(config)
+
+    keys = jax.random.split(rng, 16)
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32)
+            / math.sqrt(fan_in)
+        )
+
+    layers = {
+        "attn_norm": jnp.zeros(lead + (d,), jnp.float32),
+        "wq": dense(keys[0], lead + (d, h, hd), d),
+        "wk": dense(keys[1], lead + (d, kv, hd), d),
+        "wv": dense(keys[2], lead + (d, kv, hd), d),
+        "wo": dense(keys[3], lead + (h, hd, d), h * hd),
+        "mlp_norm": jnp.zeros(lead + (d,), jnp.float32),
+    }
+    if config.n_experts > 0:
+        e = config.n_experts
+        layers.update(
+            router=dense(keys[4], lead + (d, e), d),
+            w_gate=dense(keys[5], lead + (e, d, f), d),
+            w_up=dense(keys[6], lead + (e, d, f), d),
+            w_down=dense(keys[7], lead + (e, f, d), f),
+        )
+    else:
+        layers.update(
+            w_gate=dense(keys[5], lead + (d, f), d),
+            w_up=dense(keys[6], lead + (d, f), d),
+            w_down=dense(keys[7], lead + (f, d), f),
+        )
+
+    params = {
+        "embed": dense(keys[8], (v, d), 1.0),  # ~N(0,1) embedding
+        "layers": layers,
+        "final_norm": jnp.zeros((d,), jnp.float32),
+        "lm_head": dense(keys[9], (d, v), d),
+    }
+    return params, param_axes(config)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def transformer_layer(
+    config: TpuLMConfig,
+    layer_params: Dict[str, jnp.ndarray],
+    x,
+    positions,
+    attention_fn=None,
+):
+    """One decoder block. x: [b, s, d]; positions: [b, s] global indices.
+
+    Returns (x, moe_aux_losses or None).
+    """
+    cdt = config.compute_dtype
+    p = layer_params
+    attn_fn = attention_fn or dot_product_attention
+
+    # --- attention ------------------------------------------------------
+    residual = x
+    hx = rms_norm(x, p["attn_norm"]).astype(cdt)
+    q = jnp.einsum("bsd,dhk->bshk", hx, p["wq"].astype(cdt))
+    k = jnp.einsum("bsd,dhk->bshk", hx, p["wk"].astype(cdt))
+    v = jnp.einsum("bsd,dhk->bshk", hx, p["wv"].astype(cdt))
+    q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+    k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+    attn = attn_fn(q, k, v, causal=True,
+                   q_positions=positions, kv_positions=positions)
+    out = jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(cdt))
+    x = residual + out.astype(x.dtype)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+
+    # --- mlp ------------------------------------------------------------
+    residual = x
+    hx = rms_norm(x, p["mlp_norm"]).astype(cdt)
+    if config.n_experts > 0:
+        out, metrics = moe_lib.moe_mlp(
+            hx,
+            p["router"],
+            p["w_gate"],
+            p["w_up"],
+            p["w_down"],
+            top_k=config.moe_top_k,
+            capacity_factor=config.capacity_factor,
+        )
+        aux = metrics.aux_loss + 0.001 * metrics.router_z_loss
+    else:
+        g = jnp.einsum("bsd,df->bsf", hx, p["w_gate"].astype(cdt))
+        u = jnp.einsum("bsd,df->bsf", hx, p["w_up"].astype(cdt))
+        g = with_logical_constraint(g, ("batch", "seq", "mlp"))
+        out = jnp.einsum(
+            "bsf,fd->bsd", jax.nn.silu(g) * u, p["w_down"].astype(cdt)
+        )
+        aux = jnp.zeros((), jnp.float32)
+    x = residual + out.astype(x.dtype)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+    return x, aux
+
+
+def embed_tokens(config, params, tokens):
+    x = jnp.take(params["embed"], tokens, axis=0).astype(
+        config.compute_dtype
+    )
+    return with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+def unembed(config, params, x):
+    x = rms_norm(x, params["final_norm"]).astype(config.compute_dtype)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(config.compute_dtype)
+    )
+    return with_logical_constraint(
+        logits.astype(jnp.float32), ("batch", "seq", "vocab")
+    )
+
+
+def run_layer_stack(
+    config: TpuLMConfig,
+    layer_params,
+    x,
+    positions,
+    attention_fn=None,
+):
+    """scan over a [L, ...] stacked layer pytree (single pipeline stage)."""
+
+    def body(carry, pl):
+        y, aux = transformer_layer(
+            config, pl, carry, positions, attention_fn
+        )
+        return y, aux
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, auxes = jax.lax.scan(body, x, layer_params)
+    return x, jnp.sum(auxes)
+
+
+def forward(
+    config: TpuLMConfig,
+    params,
+    tokens,                      # [b, s] int32
+    positions=None,              # [b, s] global positions
+    attention_fn=None,
+):
+    """Full forward (non-pipelined path; trainer/pipeline.py handles
+    pp_stages > 1). Returns (logits [b, s, vocab] f32, aux_loss scalar).
+    """
+    if config.pp_stages > 1:
+        raise ValueError("use trainer.pipeline.pipelined_forward for pp>1")
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = embed_tokens(config, params, tokens)
+    x, aux = run_layer_stack(
+        config, params["layers"], x, positions, attention_fn
+    )
+    return unembed(config, params, x), aux
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy(logits, targets, mask=None, z_weight: float = 1e-4):
+    """Token-mean CE + z-loss. logits f32 [b,s,v]; targets int [b,s]."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    nll = logz - target_logit
+    zloss = z_weight * jnp.square(logz)
+    per_tok = nll + zloss
+    if mask is None:
+        return jnp.mean(per_tok)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(config, params, batch, attention_fn=None):
+    """batch: {"tokens": [b,s+1]} — next-token LM loss."""
+    tokens = batch["tokens"][:, :-1]
+    targets = batch["tokens"][:, 1:]
+    logits, aux = forward(config, params, tokens, attention_fn=attention_fn)
+    ce = cross_entropy(logits, targets, batch.get("mask"))
+    loss = ce + config.moe_aux_weight * aux
+    return loss, {"ce": ce, "aux": aux}

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts MLP with GShard-style einsum dispatch.
+
+Expert-parallel by construction: expert-stacked weights carry a leading
+("expert",) logical axis mapped to the ``ep`` mesh axis, and the dispatch/
+combine einsums contract token axes (sharded over dp/ep) against expert
+axes (sharded over ep) — XLA lowers the resharding to all-to-all over ICI.
+No per-token Python control flow: top-k and capacity assignment are
+one-hot einsum algebra, so everything stays on the MXU with static shapes.
+
+The reference has no MoE/EP support (SURVEY.md section 2.9: "absent") —
+this is parity-plus for the TPU build.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel.sharding import with_logical_constraint
+
+
+class MoEMetrics(NamedTuple):
+    aux_loss: jnp.ndarray     # load-balance loss (scalar)
+    router_z_loss: jnp.ndarray
+    dropped_fraction: jnp.ndarray
+
+
+def expert_capacity(
+    seq: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    cap = int(seq * top_k * capacity_factor / n_experts)
+    return max(cap, 1)
+
+
+def moe_mlp(
+    x,
+    router_w,     # [embed, experts]
+    w_gate,       # [experts, embed, mlp]
+    w_up,         # [experts, embed, mlp]
+    w_down,       # [experts, mlp, embed]
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+):
+    """x: [batch, seq, embed] -> (out, MoEMetrics).
+
+    Groups = batch rows (tokens within one sequence compete for expert
+    capacity). Over-capacity tokens are dropped (residual carries them).
+    """
+    b, s, d = x.shape
+    e = router_w.shape[-1]
+    cap = expert_capacity(s, e, top_k, capacity_factor)
+
+    router_logits = jnp.einsum(
+        "gsd,de->gse", x.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+
+    # --- iterative top-k one-hot assignment with capacity ---------------
+    combine = jnp.zeros((b, s, e, cap), dtype=jnp.float32)
+    remaining = probs
+    # position counters per expert, advanced between the k rounds
+    used = jnp.zeros((b, e), dtype=jnp.int32)
+    dropped = 0.0
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                   # [g, s]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [g, s, e]
+        gate = jnp.sum(remaining * onehot, axis=-1)            # [g, s]
+        remaining = remaining * (1.0 - onehot)
+        # capacity slot for each token in its chosen expert
+        pos_in_expert = (
+            jnp.cumsum(onehot, axis=1) - onehot
+        ) + used[:, None, :]                                   # [g, s, e]
+        pos = jnp.einsum("gse,gse->gs", pos_in_expert, onehot).astype(
+            jnp.int32
+        )
+        fits = pos < cap
+        dropped = dropped + jnp.mean(1.0 - fits)
+        gate = gate * fits
+        pos_onehot = jax.nn.one_hot(
+            jnp.where(fits, pos, cap), cap, dtype=jnp.float32
+        )  # out-of-range -> all-zero row
+        combine = combine + (
+            gate[..., None, None] * onehot[..., None] * pos_onehot[:, :, None, :]
+        )
+        used = used + jnp.sum(onehot * fits[..., None], axis=1).astype(jnp.int32)
+
+    # renormalize the kept gates so they sum to 1 per token (when any kept)
+    denom = jnp.sum(combine, axis=(-2, -1), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    dispatch = (combine > 0.0).astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    # --- dispatch -> expert compute -> combine --------------------------
+    # [e, g, cap, d]: token shards (dp/ep) contract into expert shards (ep)
+    expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, x)
+    expert_in = with_logical_constraint(
+        expert_in, ("expert", "batch", "capacity", "embed")
+    )
+    h = jnp.einsum("egcd,edf->egcf", expert_in, w_gate.astype(x.dtype))
+    u = jnp.einsum("egcd,edf->egcf", expert_in, w_up.astype(x.dtype))
+    h = jax.nn.silu(h) * u
+    h = with_logical_constraint(h, ("expert", "batch", "capacity", "mlp"))
+    expert_out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
+    out = jnp.einsum("egcd,gsec->gsd", expert_out, combine)
+    out = with_logical_constraint(out, ("batch", "seq", "embed"))
+
+    # --- router losses ---------------------------------------------------
+    # load-balance (Switch): E * sum_e fraction_tokens_e * mean_prob_e
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=(0, 1))
+    mean_probs = jnp.mean(probs, axis=(0, 1))
+    aux = e * jnp.sum(frac_tokens * mean_probs)
+    z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+    metrics = MoEMetrics(
+        aux_loss=aux,
+        router_z_loss=z,
+        dropped_fraction=dropped / top_k,
+    )
+    return out, metrics

@@ -1,0 +1,9 @@
+"""TPU-native ops: fused normalization, rotary embeddings, attention
+(XLA fallback, Pallas flash kernel, ring attention for sequence
+parallelism). All ops are pure functions over jnp arrays, safe under jit,
+static shapes only.
+"""
+
+from dlrover_tpu.ops.norms import rms_norm  # noqa: F401
+from dlrover_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401
+from dlrover_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,18 @@
+"""Normalization ops.
+
+RMSNorm computed in float32 regardless of input dtype (bf16-safe on TPU:
+the reduction runs in f32 on the VPU, the scale-multiply fuses into the
+surrounding matmul epilogue under XLA).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    """RMSNorm with a (1 + scale) parameterization (zero-init friendly)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * lax.rsqrt(var + eps)
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(dtype)

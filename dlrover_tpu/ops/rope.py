@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE).
+
+Takes explicit global position indices so sequence-parallel shards (each
+holding ``seq/sp`` tokens) rotate with their true positions — required by
+ring attention where the local sequence index is not the global one.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0):
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate x: [..., seq, heads, head_dim] by positions: [..., seq].
+
+    Uses the half-split convention (first half paired with second half),
+    which keeps the op a pair of multiplies + one concat — friendlier to
+    XLA fusion than interleaved lanes.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)
+    # [..., seq, head_dim//2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    # broadcast over the heads axis: [..., seq, 1, head_dim//2]
+    angles = angles[..., None, :]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        (x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -1,0 +1,23 @@
+"""Parallelism layer: device meshes, logical-axis sharding rules, and the
+dp/fsdp/tp/pp/sp/ep strategy toolkit for JAX on TPU slices.
+
+The reference (Mu-L/dlrover) delegates parallelism to torch frameworks and
+is only parallelism-*aware* (SURVEY.md section 2.9). The TPU rebuild makes
+parallelism first-class: a single ``jax.sharding.Mesh`` with axes
+``(dp, ep, pp, sp, tp)`` and GSPMD sharding propagation, with shard_map
+islands only where manual collectives beat the compiler (ring attention).
+"""
+
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    factorize_devices,
+    legal_mesh_shapes,
+)
+from dlrover_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    current_mesh,
+    logical_to_spec,
+    spec_tree,
+    with_logical_constraint,
+)

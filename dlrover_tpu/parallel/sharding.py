@@ -1,0 +1,115 @@
+"""Logical-axis sharding rules (GSPMD style).
+
+Model code annotates tensors with *logical* axis names; a rule table maps
+those to mesh axes. Swapping parallelism strategy = swapping the rule
+table, not the model. This replaces the reference's per-framework
+parallelism awareness (Megatron tp/pp ranks, FSDP shard counts —
+SURVEY.md section 2.9) with a single declarative layer.
+"""
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# logical axis -> mesh axis (or tuple of mesh axes)
+DEFAULT_RULES: Tuple[Tuple[str, MeshAxes], ...] = (
+    ("batch", ("dp", "ep")),
+    ("seq", "sp"),
+    ("embed", "dp"),       # FSDP: params' embed dim sharded over dp (ZeRO)
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("stage", "pp"),
+    ("layer", None),
+    ("expert", "ep"),
+    ("capacity", None),
+    ("norm", None),
+    ("micro", None),
+)
+
+
+def rules_dict(
+    rules: Sequence[Tuple[str, MeshAxes]] = DEFAULT_RULES,
+) -> Dict[str, MeshAxes]:
+    return dict(rules)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Sequence[Tuple[str, MeshAxes]] = DEFAULT_RULES,
+) -> P:
+    """("batch","seq","embed") -> PartitionSpec(("dp","ep"), "sp", "dp")."""
+    table = rules_dict(rules)
+    out = []
+    used = set()
+    for ax in logical_axes:
+        mesh_ax = table.get(ax) if ax is not None else None
+        # A mesh axis may appear at most once in a spec; later logical
+        # axes that map to an already-used mesh axis stay unsharded.
+        if mesh_ax is None:
+            out.append(None)
+            continue
+        flat = (mesh_ax,) if isinstance(mesh_ax, str) else tuple(mesh_ax)
+        free = tuple(a for a in flat if a not in used)
+        if not free:
+            out.append(None)
+            continue
+        used.update(free)
+        out.append(free[0] if len(free) == 1 else free)
+    return P(*out)
+
+
+def spec_tree(logical_tree, rules=DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    is_axes = lambda x: isinstance(x, tuple) and all(  # noqa: E731
+        a is None or isinstance(a, str) for a in x
+    )
+    return jax.tree_util.tree_map(
+        lambda axes: logical_to_spec(axes, rules), logical_tree,
+        is_leaf=is_axes,
+    )
+
+
+def sharding_tree(specs, mesh: Mesh):
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def with_logical_constraint(
+    x, logical_axes: Sequence[Optional[str]], rules=DEFAULT_RULES
+):
+    """Annotate an intermediate with a sharding constraint by logical axes.
+
+    No-op outside a mesh context (single-device eager/test paths). Model
+    code must be *traced* inside ``with mesh:`` for constraints to apply —
+    the train-step factory wraps its jitted callables accordingly.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = logical_to_spec(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec)
+    )
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh from the innermost ``with mesh:`` context, if any."""
+    try:
+        from jax._src import mesh as mesh_lib
+
+        env_mesh = mesh_lib.thread_resources.env.physical_mesh
+        if env_mesh.empty:
+            return None
+        return env_mesh
+    except Exception:
+        return None

@@ -1,0 +1,236 @@
+"""Sharded training step factory.
+
+Builds a jitted train step whose state (params + optimizer moments) is
+laid out by the logical-axis rules (parallel/sharding.py) over a
+(dp, ep, pp, sp, tp) mesh: FSDP via embed-dim sharding, TP via heads/mlp/
+vocab, EP via expert dims; pipeline via trainer/pipeline.py. Optimizer
+moments inherit the param shardings (ZeRO), the step counter is
+replicated. Gradient accumulation runs as a ``lax.scan`` so the global
+batch is fixed regardless of data-parallel size — the JAX analogue of the
+reference's ``ElasticTrainer`` fixed-batch grad-accum
+(trainer/torch/elastic/trainer.py:53-86).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import BATCH_AXES
+from dlrover_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_to_spec,
+    sharding_tree,
+    spec_tree,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+    grad_accum: int = 1              # microbatches per step (fixed batch)
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=tc.learning_rate,
+        warmup_steps=max(tc.warmup_steps, 1),
+        decay_steps=100_000,
+        end_value=tc.learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(
+            schedule,
+            b1=tc.beta1,
+            b2=tc.beta2,
+            weight_decay=tc.weight_decay,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# State & sharding layout
+# ---------------------------------------------------------------------------
+
+
+def state_specs(
+    config: llama.TpuLMConfig,
+    optimizer: optax.GradientTransformation,
+    rules=DEFAULT_RULES,
+) -> Dict[str, Any]:
+    """PartitionSpec pytree for {"params", "opt_state", "step"}."""
+    pshapes = jax.eval_shape(
+        lambda: llama.init_params(config, jax.random.key(0))[0]
+    )
+    param_specs = spec_tree(llama.param_axes(config), rules)
+    opt_shapes = jax.eval_shape(optimizer.init, pshapes)
+    opt_specs = optax.tree_map_params(
+        optimizer,
+        lambda _, s: s,
+        opt_shapes,
+        param_specs,
+        transform_non_params=lambda _: P(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return {"params": param_specs, "opt_state": opt_specs, "step": P()}
+
+
+def state_shardings(specs, mesh: Mesh):
+    return sharding_tree(specs, mesh)
+
+
+def batch_spec(rules=DEFAULT_RULES) -> P:
+    # tokens [batch, seq+1]: batch over (dp, ep); seq left unsharded at
+    # input (activations get re-sharded onto sp by constraint).
+    return logical_to_spec(("batch", None), rules)
+
+
+def init_train_state(
+    config: llama.TpuLMConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: jax.Array,
+    rules=DEFAULT_RULES,
+):
+    """Initialize params+opt sharded directly on the mesh (no host blowup)."""
+    specs = state_specs(config, optimizer, rules)
+    shardings = state_shardings(specs, mesh)
+
+    def init(rng):
+        params, _ = llama.init_params(config, rng)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    with mesh:
+        state = jax.jit(init, out_shardings=shardings)(rng)
+    return state, specs
+
+
+# ---------------------------------------------------------------------------
+# Train step
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(
+    config: llama.TpuLMConfig,
+    tc: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules=DEFAULT_RULES,
+    loss_fn: Optional[Callable] = None,
+    donate: bool = True,
+):
+    """Returns jitted ``step(state, batch) -> (state, metrics)``.
+
+    batch["tokens"]: [grad_accum * micro_batch, seq+1] int32. The leading
+    dim is split into ``grad_accum`` scan iterations; gradients average in
+    f32.
+    """
+    _loss = loss_fn or (
+        lambda params, batch: llama.loss_fn(config, params, batch)
+    )
+    specs = state_specs(config, optimizer, rules)
+    shardings = state_shardings(specs, mesh)
+    bspec = NamedSharding(mesh, batch_spec(rules))
+
+    def single_grad(params, micro):
+        (loss, metrics), grads = jax.value_and_grad(_loss, has_aux=True)(
+            params, micro
+        )
+        return loss, metrics, grads
+
+    def step(state, batch):
+        params = state["params"]
+        tokens = batch["tokens"]
+        ga = tc.grad_accum
+        if ga > 1:
+            if tokens.shape[0] % ga:
+                raise ValueError(
+                    f"batch {tokens.shape[0]} not divisible by "
+                    f"grad_accum {ga}"
+                )
+            mb = tokens.shape[0] // ga
+            micro_tokens = tokens.reshape(ga, mb, tokens.shape[-1])
+
+            def accum(carry, mt):
+                loss, metrics, grads = single_grad(
+                    params, {"tokens": mt}
+                )
+                g_acc, l_acc = carry
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32) / ga,
+                    g_acc,
+                    grads,
+                )
+                return (g_acc, l_acc + loss / ga), metrics
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (grads, loss), _ = jax.lax.scan(
+                accum, (zeros, jnp.zeros((), jnp.float32)), micro_tokens
+            )
+        else:
+            loss, _, grads = single_grad(params, {"tokens": tokens})
+
+        updates, new_opt = optimizer.update(
+            grads, state["opt_state"], params
+        )
+        new_params = optax.apply_updates(params, updates)
+        new_state = {
+            "params": new_params,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_state["step"],
+        }
+        return new_state, metrics
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(shardings, {"tokens": bspec}),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def run(state, batch):
+        # Trace (first call) must happen inside the mesh context so the
+        # logical sharding constraints in the model resolve.
+        with mesh:
+            return jitted(state, batch)
+
+    return run, specs
+
+
+def make_eval_step(config, mesh, rules=DEFAULT_RULES):
+    bspec = NamedSharding(mesh, batch_spec(rules))
+
+    def ev(params, batch):
+        loss, metrics = llama.loss_fn(config, params, batch)
+        return metrics["ce"]
+
+    jitted = jax.jit(ev, in_shardings=(None, {"tokens": bspec}))
+
+    def run(params, batch):
+        with mesh:  # trace inside the mesh so logical constraints apply
+            return jitted(params, batch)
+
+    return run
