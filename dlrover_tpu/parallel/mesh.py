@@ -93,8 +93,9 @@ def factorize_devices(
 
     Spreads factors of two round-robin over (tp, pp, sp, ep) — tp first
     each round so it grows fastest up to ``max_tp`` — and sends the
-    remainder (including any odd factor) to dp. Used by the driver
-    dry-run and by the auto-parallelism suggester.
+    remainder (including any odd factor) to dp. Note: configs with pp>1
+    need ``trainer.pipeline.pipelined_forward``; pass ``max_pp=1`` when
+    targeting the plain forward path.
 
     factorize_devices(8)  -> tp=2 pp=2 sp=2
     factorize_devices(64) -> tp=4 pp=4 sp=2 ep=2
