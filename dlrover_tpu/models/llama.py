@@ -306,11 +306,15 @@ def forward(
     positions=None,              # [b, s] global positions
     attention_fn=None,
 ):
-    """Full forward (non-pipelined path; trainer/pipeline.py handles
-    pp_stages > 1). Returns (logits [b, s, vocab] f32, aux_loss scalar).
+    """Full forward. Dispatches to trainer/pipeline.py when
+    pp_stages > 1. Returns (logits [b, s, vocab] f32, aux_loss scalar).
     """
     if config.pp_stages > 1:
-        raise ValueError("use trainer.pipeline.pipelined_forward for pp>1")
+        from dlrover_tpu.trainer.pipeline import pipelined_forward
+
+        return pipelined_forward(
+            config, params, tokens, positions, attention_fn
+        )
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))

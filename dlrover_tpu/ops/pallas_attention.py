@@ -1,0 +1,191 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Online-softmax tiling: grid (batch*heads, q_blocks, kv_blocks) with the
+kv dimension innermost — TPU grids run sequentially, so the running
+(acc, m, l) live in VMEM scratch across kv iterations and the output
+block is written once on the last one. Q/K/V blocks stream HBM→VMEM via
+BlockSpec; the [block_q, block_k] logits tile hits the MXU. GQA is
+handled in the index map (query head -> kv head), never materialized.
+
+Backward: custom_vjp that recomputes through the XLA reference op
+(ops/attention.py) — numerically identical semantics (tests cross-check
+all three paths), trading backward FLOPs for O(seq^2) logits memory only
+inside the bwd pass. A fused Pallas backward is a later optimization.
+
+Used for the per-device block of full attention; ring attention
+(ops/ring_attention.py) handles the sequence-parallel case.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dlrover_tpu.ops.attention import NEG_INF, dot_product_attention
+
+
+def _pick_block(s: int, target: int = 256) -> int:
+    for cand in (target, 128, 64, 32, 16, 8):
+        if s % cand == 0 and cand <= s:
+            return cand
+    return s
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+
+        m_prev = m_ref[:, :1]                       # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(logits - m_new)
+        p = jnp.where(m_blk > NEG_INF / 2, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # Whole kv block in the future -> skip the tile entirely.
+        pl.when(k_start <= q_start + block_q - 1)(body)
+    else:
+        body()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.maximum(l, 1e-30)
+        out = jnp.where(m > NEG_INF / 2, out, 0.0)
+        o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, softmax_scale, interpret):
+    b, sq, h, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = h // hkv
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    block_q = _pick_block(sq)
+    block_k = _pick_block(skv)
+    grid = (b * h, sq // block_q, skv // block_k)
+
+    def q_map(bh, qi, ki):
+        return (bh // h, qi, bh % h, 0)
+
+    def kv_map(bh, qi, ki):
+        return (bh // h, ki, (bh % h) // groups, 0)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d), q_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Drop-in for ``dot_product_attention`` with contiguous positions.
+
+    q [b, sq, h, d]; k/v [b, skv, hkv, d]; h % hkv == 0. ``interpret``
+    defaults to True off-TPU so tests run on CPU.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_forward(q, k, v, causal, softmax_scale, interpret)
+
+
+def _fwd(q, k, v, causal, softmax_scale, interpret):
+    out = flash_attention(q, k, v, causal, softmax_scale, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, softmax_scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def make_flash_attention(interpret: Optional[bool] = None):
+    """attention_fn factory for ``llama.forward``. Ignores explicit
+    positions (assumes contiguous [0..s) per call) — use ring attention
+    when the sequence axis is sharded."""
+
+    def attention_fn(
+        q, k, v, causal=True, q_positions=None, kv_positions=None,
+        softmax_scale=None,
+    ):
+        return flash_attention(
+            q, k, v, causal, softmax_scale, interpret
+        )
+
+    return attention_fn

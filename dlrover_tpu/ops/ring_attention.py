@@ -1,0 +1,140 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh
+axis (long-context path).
+
+A ``shard_map`` island inside the jitted program: Q/K/V are sharded on
+the ``sp`` mesh axis along sequence; each device computes blockwise
+attention of its local queries against the K/V block it currently holds,
+accumulating with an online (flash-style) softmax, then rotates K/V one
+hop around the ``sp`` ring via ``ppermute`` — compute and ICI transfer
+overlap, HBM never holds the full sequence. Position-based causal
+masking makes the result exact for any block arrival order.
+
+This is the long-context capability the reference lacks entirely
+(SURVEY.md §2.9: EP/CP/ring attention "absent"); the reference's
+DeepSpeed-SP awareness (docs/design/elastic.md:23-29) stops at
+checkpoint/rendezvous metadata.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.ops.attention import NEG_INF
+from dlrover_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+
+def _block_attn(q, k, v, q_pos, kv_pos, causal):
+    """Partial attention of q against one K/V block.
+
+    q: [b, sq, h, d]; k/v: [b, skv, hkv, d]. Returns (o, m, l) where
+    o = sum(exp(logits - m) @ v), m = rowwise max logits, l = rowwise
+    sum exp — the flash-attention partial triple, f32.
+    """
+    b, sq, h, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = h // hkv
+    qg = q.astype(jnp.float32).reshape(b, sq, hkv, groups, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, k.astype(jnp.float32))
+    if causal:
+        mask = q_pos[:, :, None] >= kv_pos[:, None, :]  # [b, sq, skv]
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                        # [b, hkv, g, sq]
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where((m > NEG_INF / 2)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bkgqs,bskd->bkgqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention_local(
+    q,
+    k,
+    v,
+    q_positions,
+    kv_positions,
+    axis_name: str = "sp",
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+):
+    """Per-shard body (call under shard_map). Shapes are LOCAL:
+    q [b, sq_loc, h, d]; k/v [b, skv_loc, hkv, d]; positions are the
+    GLOBAL token indices of the local rows ([b, sq_loc]/[b, skv_loc]).
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    groups = h // hkv
+    n = jax.lax.axis_size(axis_name)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    q = q * scale
+
+    o0 = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
+    m0 = jnp.full((b, hkv, groups, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, groups, sq), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur, kv_pos = carry
+        bo, bm, bl = _block_attn(q, k_cur, v_cur, q_positions, kv_pos, causal)
+        m_new = jnp.maximum(m, bm)
+        corr = jnp.exp(m - m_new)
+        bcorr = jnp.exp(bm - m_new)
+        o = o * corr[..., None] + bo * bcorr[..., None]
+        l = l * corr + bl * bcorr
+        m = m_new
+        # Rotate K/V one hop around the ring (overlaps with next block's
+        # compute under XLA latency hiding).
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
+        return (o, m, l, k_cur, v_cur, kv_pos)
+
+    o, m, l, _, _, _ = jax.lax.fori_loop(
+        0, n, step, (o0, m0, l0, k, v, kv_positions)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((m > NEG_INF / 2)[..., None], out, 0.0)
+    # [b, hkv, g, sq, d] -> [b, sq, h, d]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, sq, h, d)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, rules=DEFAULT_RULES, axis_name="sp"):
+    """Returns an ``attention_fn`` drop-in for ``dot_product_attention``
+    that runs ring attention along ``axis_name`` via a shard_map island.
+    Plug into ``llama.forward(..., attention_fn=...)``.
+    """
+    q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), rules)
+    kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), rules)
+    pos_spec = logical_to_spec(("batch", "seq"), rules)
+
+    def attention_fn(
+        q, k, v, causal=True, q_positions=None, kv_positions=None,
+        softmax_scale=None,
+    ):
+        b, sq = q.shape[0], q.shape[1]
+        skv = k.shape[1]
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(jnp.arange(sq), (b, sq))
+        if kv_positions is None:
+            kv_positions = jnp.broadcast_to(jnp.arange(skv), (b, skv))
+        q_positions = jnp.broadcast_to(q_positions, (b, sq))
+        kv_positions = jnp.broadcast_to(kv_positions, (b, skv))
+
+        body = functools.partial(
+            ring_attention_local,
+            axis_name=axis_name,
+            causal=causal,
+            softmax_scale=softmax_scale,
+        )
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )(q, k, v, q_positions, kv_positions)
+
+    return attention_fn

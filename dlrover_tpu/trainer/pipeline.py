@@ -1,0 +1,119 @@
+"""SPMD pipeline parallelism (GPipe schedule) for TpuLM.
+
+Idiomatic-TPU design: instead of per-rank send/recv (the Megatron pattern
+the reference delegates to, SURVEY.md §2.9), the whole pipeline runs
+inside ONE jitted program. Layer params carry a leading ``stage`` dim
+sharded over the ``pp`` mesh axis; activations live in a
+``[stages, microbatch, seq, embed]`` buffer with the same sharding. Each
+tick vmaps the per-stage layer stack over the stage dim (XLA partitions
+it so every pp group computes exactly its stage) and then shifts the
+buffer one slot along ``stage`` — which GSPMD lowers to a
+``collective-permute`` riding the ICI ring. ``lax.scan`` over
+``num_microbatches + stages - 1`` ticks gives the GPipe schedule with
+bubble fraction (S-1)/(M+S-1); gradients flow through the scan
+automatically, so the same code serves forward and backward.
+
+Parity note: the reference has no pipeline implementation of its own —
+it is parallelism-aware only (rendezvous ``node_unit``, Megatron ckpt
+layouts). This module is parity-plus work enabling the flagship model to
+actually train with pp on TPU meshes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.sharding import with_logical_constraint
+
+
+def pipelined_forward(
+    config,
+    params,
+    tokens,                      # [b, s] int32
+    positions=None,              # [b, s] global positions
+    attention_fn=None,
+):
+    """Returns (logits [b, s, vocab] f32, aux_loss scalar).
+
+    Requires ``b % config.num_microbatches == 0``. Embedding and unembed
+    run outside the pipeline loop (their params are replicated over pp).
+    """
+    S = config.pp_stages
+    M = config.num_microbatches
+    b, s = tokens.shape
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by microbatches {M}")
+    mb = b // M
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = llama.embed_tokens(config, params, tokens)      # [b, s, d]
+    d = x.shape[-1]
+    micro_x = x.reshape(M, mb, s, d)
+    micro_pos = positions.reshape(M, mb, s)
+
+    def constrain_state(st):
+        return with_logical_constraint(
+            st, ("stage", "batch", "seq", "embed")
+        )
+
+    def stage_fn(stage_layers, xi, pos_i):
+        return llama.run_layer_stack(
+            config, stage_layers, xi, pos_i, attention_fn
+        )
+
+    state = constrain_state(jnp.zeros((S, mb, s, d), x.dtype))
+    pos_state = jnp.zeros((S, mb, s), positions.dtype)
+    outputs = jnp.zeros((M, mb, s, d), x.dtype)
+    stage_ids = jnp.arange(S)
+
+    def tick(carry, t):
+        state, pos_state, outputs, aux = carry
+        # Feed the next microbatch into stage 0 (garbage after t >= M;
+        # masked out of aux/outputs below).
+        inp = jax.lax.dynamic_index_in_dim(
+            micro_x, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        pin = jax.lax.dynamic_index_in_dim(
+            micro_pos, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        state = constrain_state(state.at[0].set(inp))
+        pos_state = pos_state.at[0].set(pin)
+
+        processed, aux_t = jax.vmap(stage_fn)(
+            params["layers"], state, pos_state
+        )
+        processed = constrain_state(processed)
+
+        # Stage i holds microbatch t - i this tick.
+        valid = (t - stage_ids >= 0) & (t - stage_ids < M)
+        aux = aux + jnp.sum(aux_t * valid.astype(aux_t.dtype))
+
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        prev = jax.lax.dynamic_index_in_dim(
+            outputs, out_idx, axis=0, keepdims=False
+        )
+        new_out = jnp.where(valid[S - 1], processed[S - 1], prev)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, new_out, out_idx, axis=0
+        )
+
+        # Shift along stage: processed[i] -> state[i+1]. On a pp-sharded
+        # mesh axis this is a collective-permute over ICI; slot 0 is
+        # overwritten at the next tick.
+        state = constrain_state(jnp.roll(processed, 1, axis=0))
+        pos_state = jnp.roll(pos_state, 1, axis=0)
+        return (state, pos_state, outputs, aux), None
+
+    aux0 = jnp.zeros((), jnp.float32)
+    (state, pos_state, outputs, aux), _ = jax.lax.scan(
+        tick,
+        (state, pos_state, outputs, aux0),
+        jnp.arange(M + S - 1),
+    )
+
+    x = outputs.reshape(b, s, d)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+    # Mean over microbatches: aux magnitude must not scale with M (same
+    # convention as grad-accum averaging in make_train_step).
+    return llama.unembed(config, params, x), aux / M

@@ -1,8 +1,9 @@
 """TpuLM training worker for e2e verification.
 
 Trains the flagship model on synthetic data over an 8-virtual-device CPU
-mesh (dp=2, sp=2, tp=2) and asserts the loss drops. (Sharded flash-ckpt
-integration is exercised by the dedicated checkpoint worker, not here.)
+mesh (dp=2, pp=2, sp=2) — pipeline parallelism + ring attention — and
+asserts the loss drops. (Sharded flash-ckpt integration is exercised by
+the dedicated checkpoint worker, not here.)
 """
 
 import os
@@ -21,6 +22,7 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from dlrover_tpu.models import llama
+from dlrover_tpu.ops.ring_attention import make_ring_attention
 from dlrover_tpu.parallel import MeshConfig, build_mesh
 from dlrover_tpu.trainer import train_step as ts
 from dlrover_tpu.trainer.runtime import init_distributed
@@ -31,12 +33,16 @@ def main():
     out_path = sys.argv[2]
 
     init_distributed()
-    cfg = llama.tiny_config()
-    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    cfg = llama.tiny_config(pp_stages=2, num_microbatches=2)
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, sp=2))
+    ring = make_ring_attention(mesh)
     tc = ts.TrainConfig(learning_rate=5e-3, warmup_steps=2)
     opt = ts.make_optimizer(tc)
     state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
-    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh)
+    step_fn, _ = ts.make_train_step(
+        cfg, tc, opt, mesh,
+        loss_fn=lambda p, b: llama.loss_fn(cfg, p, b, attention_fn=ring),
+    )
 
     batch = {
         "tokens": jax.random.randint(
