@@ -87,7 +87,9 @@ class Node:
         self.relaunchable = True
         self.is_released = False
         self.exit_reason = ""
-        self.create_time: Optional[float] = None
+        # When the master asked the backend for this node; pending-timeout
+        # is measured from here.
+        self.create_time: Optional[float] = time.time()
         self.start_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.heartbeat_time: float = 0.0
@@ -144,6 +146,7 @@ class Node:
         new_node.id = new_id
         new_node.name = f"{self.type}-{new_id}"
         new_node.status = NodeStatus.INITIAL
+        new_node.create_time = time.time()
         new_node.start_time = None
         new_node.finish_time = None
         new_node.is_released = False

@@ -11,7 +11,7 @@ def build_master_parser() -> argparse.ArgumentParser:
         "--platform",
         type=str,
         default="local",
-        choices=["local", "k8s", "gke_tpu"],
+        choices=["local", "sim", "k8s", "gke_tpu"],
         help="cluster backend",
     )
     parser.add_argument("--node_num", type=int, default=1)

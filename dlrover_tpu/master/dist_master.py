@@ -1,0 +1,209 @@
+"""Distributed job master: one per job, owns all managers.
+
+Parity: reference dlrover/python/master/dist_master.py:101-457
+(DistributedJobMaster.prepare/run/pre_check) — the supervision loop ticks
+every few seconds checking: workers all exited, training hang, pending
+timeout; a parallel diagnose thread executes job-level DiagnosisActions
+(JobRestartAction/JobAbortionAction/NodeAction, reference :236-263).
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    JobConstant,
+    JobExitReason,
+    NodeType,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    create_rdzv_managers,
+)
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.event_callback import (
+    AllReduceNodeHandlingCallback,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.rpc.transport import create_master_server
+
+
+class DistributedJobMaster:
+    def __init__(
+        self,
+        port: int,
+        job_name: str,
+        node_num: int,
+        scaler,
+        watcher,
+        max_relaunch_count: int = 3,
+        transport: str = "grpc",
+        node_resource: Optional[NodeResource] = None,
+        diagnosis_master=None,
+        heartbeat_timeout_s: float = 600.0,
+        pending_timeout_s: float = 900.0,
+    ):
+        self.job_name = job_name
+        self._job_context = get_job_context()
+        self.perf_monitor = PerfMonitor()
+        self.task_manager = TaskManager(perf_monitor=self.perf_monitor)
+        self.rdzv_managers = create_rdzv_managers()
+        self.diagnosis_master = diagnosis_master
+        node_groups = {
+            NodeType.WORKER: NodeGroupResource(
+                count=node_num,
+                node_resource=node_resource or NodeResource(),
+            )
+        }
+        self.job_manager = DistributedJobManager(
+            job_name=job_name,
+            node_groups=node_groups,
+            scaler=scaler,
+            watcher=watcher,
+            max_relaunch_count=max_relaunch_count,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            pending_timeout_s=pending_timeout_s,
+        )
+        self.job_manager.add_node_event_callback(
+            AllReduceNodeHandlingCallback(self)
+        )
+        self.job_manager.add_node_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.servicer = MasterServicer(
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            diagnosis_master=diagnosis_master,
+            perf_monitor=self.perf_monitor,
+        )
+        self._server = create_master_server(port, self.servicer, transport)
+        self.port = self._server.port
+        self._node_num = node_num
+        self._stopped = threading.Event()
+        self.exit_reason = ""
+
+    @classmethod
+    def from_args(cls, args) -> "DistributedJobMaster":
+        """Build the master for a CLI platform choice (reference
+        master/main.py + scheduler/factory.py new_job_args)."""
+        if args.platform == "sim":
+            from dlrover_tpu.testing.sim_cluster import (
+                SimCluster,
+                SimNodeWatcher,
+                SimScaler,
+            )
+
+            cluster = SimCluster()
+            scaler = SimScaler(args.job_name, cluster)
+            watcher = SimNodeWatcher(args.job_name, cluster)
+        elif args.platform in ("k8s", "gke_tpu"):
+            try:
+                from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+                from dlrover_tpu.master.watcher.k8s_watcher import PodWatcher
+            except ImportError as e:
+                raise SystemExit(
+                    f"platform {args.platform!r} needs the kubernetes "
+                    f"backend: {e}"
+                )
+            scaler = PodScaler(args.job_name, args.namespace)
+            watcher = PodWatcher(args.job_name, args.namespace)
+        else:
+            raise ValueError(f"unknown platform {args.platform!r}")
+        return cls(
+            port=args.port,
+            job_name=args.job_name,
+            node_num=args.node_num,
+            scaler=scaler,
+            watcher=watcher,
+            max_relaunch_count=args.max_relaunch_count,
+            transport=args.transport,
+        )
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def prepare(self):
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=self._node_num,
+                max_nodes=self._node_num,
+                waiting_timeout=30.0,
+            )
+        self._server.start()
+        self.job_manager.start()
+        self.task_manager.start()
+        if self.diagnosis_master is not None:
+            self.diagnosis_master.start_observing()
+        logger.info(
+            "distributed master [%s] serving on port %d (%d workers)",
+            self.job_name,
+            self.port,
+            self._node_num,
+        )
+
+    def pre_check(self) -> bool:
+        if self.diagnosis_master is None:
+            return True
+        return self.diagnosis_master.pre_check()
+
+    def run(self) -> int:
+        diag_thread = threading.Thread(
+            target=self._diagnose_loop, name="master-diagnose", daemon=True
+        )
+        diag_thread.start()
+        try:
+            while not self._stopped.is_set():
+                time.sleep(JobConstant.MASTER_RUN_LOOP_INTERVAL)
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        self.exit_reason = JobExitReason.SUCCEEDED
+                        logger.info("all workers succeeded; master exiting")
+                        return 0
+                    self.exit_reason = JobExitReason.WORKER_ERROR
+                    logger.error("workers failed; master exiting")
+                    return 1
+                if self.job_manager.pending_timed_out():
+                    self.exit_reason = JobExitReason.UNKNOWN
+                    logger.error("workers pending too long; aborting job")
+                    return 1
+                if self.task_manager.finished():
+                    logger.info("all data shards consumed; job finishing")
+                    self.exit_reason = JobExitReason.SUCCEEDED
+                    return 0
+            return 0 if self.exit_reason == JobExitReason.SUCCEEDED else 1
+        finally:
+            self.stop()
+
+    def _diagnose_loop(self):
+        """Execute master-level diagnosis actions (reference
+        dist_master.py:236 _diagnose_job)."""
+        while not self._stopped.is_set():
+            time.sleep(1.0)
+            action = self._job_context.next_master_action()
+            if action is None:
+                continue
+            if action.action_type == DiagnosisActionType.JOB_RESTART:
+                logger.warning("diagnosis: restarting workers (%s)",
+                               action.reason)
+                self.job_manager.restart_worker_processes(action.reason)
+            elif action.action_type == DiagnosisActionType.JOB_ABORT:
+                logger.error("diagnosis: aborting job (%s)", action.reason)
+                self.exit_reason = JobExitReason.HANG_ERROR
+                self._stopped.set()
+
+    def stop(self):
+        self._stopped.set()
+        if self.diagnosis_master is not None:
+            self.diagnosis_master.stop_observing()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop()
+
+    def request_stop(self):
+        self._stopped.set()

@@ -32,6 +32,11 @@ def run(args) -> int:
             )
         master = DistributedJobMaster.from_args(args)
     master.prepare()
+    if args.pre_check and hasattr(master, "pre_check"):
+        if not master.pre_check():
+            logger.error("pre-check failed; aborting job")
+            master.stop()
+            return 1
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:

@@ -1,0 +1,258 @@
+"""Distributed master + sim cluster backend tests.
+
+Mirrors the reference's mock-k8s master tests (tests/test_job_manager.py)
+using the in-memory simulator (dlrover_tpu/testing/sim_cluster.py) instead
+of a faked k8s API.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    JobStage,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.testing.sim_cluster import (
+    SimCluster,
+    SimNodeWatcher,
+    SimScaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def make_manager(node_num=2, max_relaunch=2, **kwargs):
+    cluster = SimCluster()
+    scaler = SimScaler("test-job", cluster)
+    watcher = SimNodeWatcher("test-job", cluster)
+    mgr = DistributedJobManager(
+        job_name="test-job",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=node_num, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        scaler=scaler,
+        watcher=watcher,
+        max_relaunch_count=max_relaunch,
+        **kwargs,
+    )
+    return mgr, cluster
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def running_nodes(mgr):
+    return [
+        n
+        for n in mgr.worker_manager.nodes.values()
+        if n.status == NodeStatus.RUNNING
+    ]
+
+
+def test_start_creates_and_runs_workers():
+    mgr, cluster = make_manager(node_num=3)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 3)
+        assert get_job_context().job_stage == JobStage.RUNNING
+        ranks = sorted(n.rank_index for n in running_nodes(mgr))
+        assert ranks == [0, 1, 2]
+    finally:
+        mgr.stop()
+
+
+def test_failed_worker_is_relaunched_with_same_rank():
+    mgr, cluster = make_manager(node_num=2)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+        victim = running_nodes(mgr)[0]
+        cluster.fail_node(victim.id)
+        # A replacement with the same rank but a new id appears.
+        assert wait_until(
+            lambda: any(
+                n.rank_index == victim.rank_index
+                and n.id != victim.id
+                and n.status == NodeStatus.RUNNING
+                for n in mgr.worker_manager.nodes.values()
+            )
+        )
+        assert get_job_context().failure_count == 1
+        replacement = [
+            n
+            for n in mgr.worker_manager.nodes.values()
+            if n.rank_index == victim.rank_index and n.id != victim.id
+        ][0]
+        assert replacement.relaunch_count == 1
+    finally:
+        mgr.stop()
+
+
+def test_preempted_worker_is_replaced():
+    mgr, cluster = make_manager(node_num=2)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+        victim = running_nodes(mgr)[1]
+        cluster.preempt_node(victim.id)
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+    finally:
+        mgr.stop()
+
+
+def test_fatal_error_is_not_relaunched():
+    mgr, cluster = make_manager(node_num=1)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 1)
+        victim = running_nodes(mgr)[0]
+        cluster.fail_node(victim.id, NodeExitReason.FATAL_ERROR)
+        assert wait_until(mgr.all_workers_exited)
+        assert not mgr.all_workers_succeeded()
+        # No new incarnation was created.
+        assert len(mgr.worker_manager.nodes) == 1
+    finally:
+        mgr.stop()
+
+
+def test_relaunch_budget_exhausted():
+    mgr, cluster = make_manager(node_num=1, max_relaunch=1)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 1)
+        first = running_nodes(mgr)[0]
+        cluster.fail_node(first.id)
+        assert wait_until(
+            lambda: any(
+                n.id != first.id and n.status == NodeStatus.RUNNING
+                for n in mgr.worker_manager.nodes.values()
+            )
+        )
+        second = [
+            n for n in mgr.worker_manager.nodes.values() if n.id != first.id
+        ][0]
+        cluster.fail_node(second.id)
+        assert wait_until(mgr.all_workers_exited)
+        assert len(mgr.worker_manager.nodes) == 2
+    finally:
+        mgr.stop()
+
+
+def test_all_workers_succeeded():
+    mgr, cluster = make_manager(node_num=2)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+        for node in running_nodes(mgr):
+            cluster.succeed_node(node.id)
+        assert wait_until(mgr.all_workers_exited)
+        assert mgr.all_workers_succeeded()
+    finally:
+        mgr.stop()
+
+
+def test_worker_scale_up_and_down():
+    mgr, cluster = make_manager(node_num=2)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+        plan = mgr.worker_manager.adjust_worker(4)
+        mgr._scaler.scale(plan)
+        assert wait_until(lambda: len(running_nodes(mgr)) == 4)
+        ranks = sorted(n.rank_index for n in running_nodes(mgr))
+        assert ranks == [0, 1, 2, 3]
+        plan = mgr.worker_manager.adjust_worker(2)
+        mgr._scaler.scale(plan)
+        assert wait_until(lambda: len(running_nodes(mgr)) == 2)
+        ranks = sorted(n.rank_index for n in running_nodes(mgr))
+        assert ranks == [0, 1]
+    finally:
+        mgr.stop()
+
+
+def test_heartbeat_timeout_marks_node_failed():
+    mgr, cluster = make_manager(node_num=1, heartbeat_timeout_s=0.5)
+    try:
+        mgr.start()
+        assert wait_until(lambda: len(running_nodes(mgr)) == 1)
+        node = running_nodes(mgr)[0]
+        node.heartbeat_time = time.time() - 10
+        # Heartbeat monitor notices within ~1s tick and relaunches.
+        assert wait_until(
+            lambda: any(
+                n.id != node.id for n in mgr.worker_manager.nodes.values()
+            ),
+            timeout=5.0,
+        )
+    finally:
+        mgr.stop()
+
+
+def test_pending_timeout_fires_when_unschedulable():
+    cluster = SimCluster()
+    cluster.schedulable = False
+    scaler = SimScaler("test-job", cluster)
+    watcher = SimNodeWatcher("test-job", cluster)
+    mgr = DistributedJobManager(
+        job_name="test-job",
+        node_groups={NodeType.WORKER: NodeGroupResource(count=2)},
+        scaler=scaler,
+        watcher=watcher,
+        pending_timeout_s=0.2,
+    )
+    try:
+        mgr.start()
+        assert wait_until(mgr.pending_timed_out, timeout=3.0)
+    finally:
+        mgr.stop()
+
+
+def test_master_restart_adopts_existing_nodes():
+    cluster = SimCluster()
+    mgr1, _ = make_manager(node_num=2)
+    mgr1._scaler._cluster = cluster
+    mgr1._watcher._cluster = cluster
+    mgr1.start()
+    assert wait_until(lambda: len(cluster.list_nodes()) == 2)
+    mgr1.stop()
+
+    # A new master over the same (still-running) cluster must adopt the
+    # two live nodes instead of doubling the worker set.
+    JobContext.reset_singleton()
+    scaler = SimScaler("test-job", cluster)
+    watcher = SimNodeWatcher("test-job", cluster)
+    mgr2 = DistributedJobManager(
+        job_name="test-job",
+        node_groups={NodeType.WORKER: NodeGroupResource(count=2)},
+        scaler=scaler,
+        watcher=watcher,
+    )
+    try:
+        mgr2.start()
+        time.sleep(0.3)
+        assert len(cluster.list_nodes()) == 2
+        ranks = sorted(
+            n.rank_index for n in mgr2.worker_manager.nodes.values()
+        )
+        assert ranks == [0, 1]
+    finally:
+        mgr2.stop()
