@@ -82,9 +82,24 @@ class ElasticAgent:
         spec: WorkerSpec,
         client: MasterClient,
         ckpt_saver=None,
+        diagnosis_agent=None,
     ):
         self._spec = spec
         self._client = client
+        if diagnosis_agent is None:
+            from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent
+
+            log_path = ""
+            if spec.redirect_output:
+                log_path = os.path.join(
+                    spec.redirect_output, f"worker-{spec.node_rank}-0.log"
+                )
+            diagnosis_agent = DiagnosisAgent(
+                master_client=client,
+                node_id=spec.node_rank,
+                log_path=log_path,
+            )
+        self._diagnosis_agent = diagnosis_agent
         self._rdzv = MasterRendezvousHandler(
             client,
             spec.node_rank,
@@ -263,9 +278,17 @@ class ElasticAgent:
                 self._ckpt_saver.save_shm_on_failure()
             except Exception:
                 logger.exception("breakpoint checkpoint save failed")
-        hardware_fault = any(
-            c in (ExitCode.HARDWARE_ERROR, ExitCode.GPU_DRIVER_ERROR)
-            for c in codes.values()
+        from dlrover_tpu.agent.diagnosis_agent import (
+            FailureContext,
+            WorkerAction,
+        )
+
+        decision = self._diagnosis_agent.diagnose_training_failure(
+            FailureContext(
+                exit_codes=codes,
+                restart_count=self._restart_count,
+                max_restarts=self._spec.max_restarts,
+            )
         )
         try:
             self._client.report_failure(
@@ -274,14 +297,14 @@ class ElasticAgent:
                 restart_count=self._restart_count,
                 exit_code=next(iter(codes.values()), 1),
                 level=TrainingExceptionLevel.NODE_ERROR
-                if hardware_fault
+                if decision == WorkerAction.RELAUNCH_NODE
                 else TrainingExceptionLevel.PROCESS_ERROR,
             )
         except Exception:
             logger.warning("failure report failed", exc_info=True)
-        if hardware_fault:
+        if decision == WorkerAction.RELAUNCH_NODE:
             return RunResult.RELAUNCH
-        if self._restart_count >= self._spec.max_restarts:
+        if decision == WorkerAction.FAIL_JOB:
             logger.error(
                 "max restarts (%d) exhausted", self._spec.max_restarts
             )
@@ -292,6 +315,7 @@ class ElasticAgent:
     # ---- main loop ---------------------------------------------------------
 
     def run(self) -> RunResult:
+        self._diagnosis_agent.start()
         try:
             return self._run()
         except RendezvousEvictedError:
@@ -311,6 +335,8 @@ class ElasticAgent:
             except Exception:
                 pass
             return RunResult.RELAUNCH
+        finally:
+            self._diagnosis_agent.stop()
 
     def _run(self) -> RunResult:
         spec = self._spec
@@ -371,4 +397,5 @@ class ElasticAgent:
 
     def stop(self):
         self._stopping = True
+        self._diagnosis_agent.stop()
         self._stop_workers()

@@ -1,0 +1,84 @@
+"""Training-hang diagnostician.
+
+Parity: reference dlrover/python/diagnosis/diagnostician/training_hang.py
+:61-339 (TrainingHangDiagnostician) — detects a hung job from global-step
+stagnation while all nodes still heartbeat (the XLA-collective-deadlock
+signature: processes alive, no step progress), escalating from an
+observability event to a job-level restart.
+
+TPU note: without per-kernel NCCL introspection the primary hang signal
+is step stagnation from the PerfMonitor plus (when the native profiler is
+running) a frozen executable-launch counter from tpu_timer metrics.
+"""
+
+import time
+
+from dlrover_tpu.diagnosis.actions import (
+    DiagnosisAction,
+    EventAction,
+    JobRestartAction,
+)
+from dlrover_tpu.diagnosis.diagnostician import Diagnostician, Observation
+
+_HANG_OBSERVATION = "training-hang"
+
+
+class TrainingHangDiagnostician(Diagnostician):
+    observe_interval_s = 30.0
+
+    def __init__(
+        self,
+        perf_monitor,
+        job_manager=None,
+        hang_timeout_s: float = 600.0,
+        restart_after_s: float = 1800.0,
+    ):
+        self._perf_monitor = perf_monitor
+        self._job_manager = job_manager
+        self._hang_timeout_s = hang_timeout_s
+        self._restart_after_s = restart_after_s
+        self._hang_since = 0.0
+
+    def observe(self, **kwargs) -> Observation:
+        started = self._perf_monitor.global_step > 0
+        stagnated = started and self._perf_monitor.step_stagnated(
+            self._hang_timeout_s
+        )
+        nodes_alive = True
+        if self._job_manager is not None and hasattr(
+            self._job_manager, "all_running_node_hanged"
+        ):
+            # If nodes stopped heartbeating this is a failure, not a hang;
+            # the heartbeat monitor handles it.
+            nodes_alive = not self._job_manager.all_running_node_hanged()
+        if stagnated and nodes_alive:
+            if self._hang_since == 0.0:
+                self._hang_since = time.time()
+            return Observation(
+                observation=_HANG_OBSERVATION,
+                extra={
+                    "step": str(self._perf_monitor.global_step),
+                    "hang_for_s": f"{time.time() - self._hang_since:.0f}",
+                },
+            )
+        self._hang_since = 0.0
+        return Observation()
+
+    def resolve(self, ob: Observation, **kwargs) -> DiagnosisAction:
+        hang_for = time.time() - self._hang_since
+        if hang_for >= self._restart_after_s:
+            self._hang_since = 0.0
+            return JobRestartAction(
+                reason=(
+                    f"no step progress for {hang_for:.0f}s at step "
+                    f"{ob.extra.get('step')}"
+                )
+            )
+        return EventAction(
+            event_type="warning",
+            event_msg=(
+                f"training hang suspected: step {ob.extra.get('step')} "
+                f"stalled for {ob.extra.get('hang_for_s')}s"
+            ),
+            reason=_HANG_OBSERVATION,
+        )

@@ -48,13 +48,14 @@ class DistributedJobMaster:
         diagnosis_master=None,
         heartbeat_timeout_s: float = 600.0,
         pending_timeout_s: float = 900.0,
+        with_diagnosis: bool = True,
+        pre_check: bool = False,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager(perf_monitor=self.perf_monitor)
         self.rdzv_managers = create_rdzv_managers()
-        self.diagnosis_master = diagnosis_master
         node_groups = {
             NodeType.WORKER: NodeGroupResource(
                 count=node_num,
@@ -76,6 +77,9 @@ class DistributedJobMaster:
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
         )
+        if diagnosis_master is None and with_diagnosis:
+            diagnosis_master = self._build_diagnosis_master(pre_check)
+        self.diagnosis_master = diagnosis_master
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
@@ -88,6 +92,42 @@ class DistributedJobMaster:
         self._node_num = node_num
         self._stopped = threading.Event()
         self.exit_reason = ""
+
+    def _build_diagnosis_master(self, pre_check: bool):
+        from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
+        from dlrover_tpu.diagnosis.diagnosticians.node_failure import (
+            NodeFailureDiagnostician,
+            NodeInconsistencyDiagnostician,
+        )
+        from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+            TrainingHangDiagnostician,
+        )
+        from dlrover_tpu.diagnosis.precheck import (
+            ConnectionPreCheckOperator,
+            SchedulingPreCheckOperator,
+        )
+        from dlrover_tpu.master.diagnosis.diagnosis_master import (
+            DiagnosisMaster,
+        )
+
+        manager = DiagnosisManager()
+        manager.register(
+            TrainingHangDiagnostician(self.perf_monitor, self.job_manager)
+        )
+        manager.register(NodeFailureDiagnostician())
+        manager.register(NodeInconsistencyDiagnostician())
+        operators = []
+        if pre_check:
+            operators = [
+                SchedulingPreCheckOperator(self.job_manager),
+                # Lazy: the servicer exists by the time pre_check() runs.
+                ConnectionPreCheckOperator(
+                    lambda: self.servicer.node_last_contact()
+                ),
+            ]
+        return DiagnosisMaster(
+            pre_check_operators=operators, manager=manager
+        )
 
     @classmethod
     def from_args(cls, args) -> "DistributedJobMaster":
@@ -124,6 +164,7 @@ class DistributedJobMaster:
             watcher=watcher,
             max_relaunch_count=args.max_relaunch_count,
             transport=args.transport,
+            pre_check=getattr(args, "pre_check", False),
         )
 
     # ---- lifecycle ---------------------------------------------------------

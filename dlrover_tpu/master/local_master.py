@@ -38,16 +38,36 @@ class LocalJobMaster:
         self.rdzv_managers = create_rdzv_managers()
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager(perf_monitor=self.perf_monitor)
+        self.diagnosis_master = self._build_diagnosis_master()
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
             job_manager=self.job_manager,
+            diagnosis_master=self.diagnosis_master,
             perf_monitor=self.perf_monitor,
         )
         self._server = create_master_server(port, self.servicer, transport)
         self.port = self._server.port
         self._node_num = node_num
         self._stopped = threading.Event()
+
+    def _build_diagnosis_master(self):
+        from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
+        from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+            TrainingHangDiagnostician,
+        )
+        from dlrover_tpu.master.diagnosis.diagnosis_master import (
+            DiagnosisMaster,
+        )
+
+        from dlrover_tpu.diagnosis.diagnosticians.node_failure import (
+            NodeFailureDiagnostician,
+        )
+
+        manager = DiagnosisManager()
+        manager.register(TrainingHangDiagnostician(self.perf_monitor))
+        manager.register(NodeFailureDiagnostician())
+        return DiagnosisMaster(manager=manager)
 
     def prepare(self):
         for mgr in self.rdzv_managers.values():
@@ -59,6 +79,7 @@ class LocalJobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        self.diagnosis_master.start_observing()
         logger.info(
             "local master [%s] serving on port %d", self.job_name, self.port
         )
@@ -74,12 +95,34 @@ class LocalJobMaster:
                         return 0
                     logger.error("workers failed; master exiting")
                     return 1
+                rc = self._execute_master_actions()
+                if rc is not None:
+                    return rc
             return 0
         finally:
             self.stop()
 
+    def _execute_master_actions(self):
+        """Consume job-level diagnosis actions (hang -> restart/abort),
+        mirroring DistributedJobMaster._diagnose_loop for standalone."""
+        from dlrover_tpu.common.constants import DiagnosisActionType
+
+        while True:
+            action = self._job_context.next_master_action()
+            if action is None:
+                return None
+            if action.action_type == DiagnosisActionType.JOB_RESTART:
+                logger.warning(
+                    "diagnosis: restarting workers (%s)", action.reason
+                )
+                self.job_manager.restart_worker_processes(action.reason)
+            elif action.action_type == DiagnosisActionType.JOB_ABORT:
+                logger.error("diagnosis: aborting job (%s)", action.reason)
+                return 1
+
     def stop(self):
         self._stopped.set()
+        self.diagnosis_master.stop_observing()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()

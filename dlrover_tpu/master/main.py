@@ -32,16 +32,18 @@ def run(args) -> int:
             )
         master = DistributedJobMaster.from_args(args)
     master.prepare()
+    if args.port_file:
+        # Publish the port before any blocking pre-check: agents need it
+        # to reach the master, and the connection pre-check needs agents.
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(master.port))
+        os.rename(tmp, args.port_file)
     if args.pre_check and hasattr(master, "pre_check"):
         if not master.pre_check():
             logger.error("pre-check failed; aborting job")
             master.stop()
             return 1
-    if args.port_file:
-        tmp = args.port_file + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(master.port))
-        os.rename(tmp, args.port_file)
     return master.run()
 
 

@@ -119,6 +119,18 @@ class LocalJobManager:
             nodes=nodes,
         )
 
+    def restart_worker_processes(self, reason: str):
+        """Queue an in-place restart for every still-running node."""
+        from dlrover_tpu.diagnosis.actions import NodeAction
+
+        for node in self._job_context.get_nodes().values():
+            if node.status == NodeStatus.RUNNING:
+                self._job_context.enqueue_action(
+                    NodeAction(
+                        instance=node.id, node_id=node.id, reason=reason
+                    )
+                )
+
     # ---- queries used by the master run loop --------------------------------
 
     def all_workers_exited(self) -> bool:

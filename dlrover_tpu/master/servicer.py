@@ -52,6 +52,10 @@ class MasterServicer(MasterService):
         self._pre_check_status = PreCheckStatus.PASS
         self._elastic_run_config: Dict[str, str] = {}
         self._start_time = time.time()
+        # node_id -> wall time of its last RPC; the connection pre-check
+        # uses "has talked to the master at all" as the liveness signal
+        # (agents poll wait_pre_check before their first heartbeat).
+        self._node_last_contact: Dict[int, float] = {}
 
         self._get_handlers = {
             comm.CommWorldRequest: self._get_comm_world,
@@ -95,7 +99,11 @@ class MasterServicer(MasterService):
 
     # ---- transport entry points -------------------------------------------
 
+    def node_last_contact(self) -> Dict[int, float]:
+        return dict(self._node_last_contact)
+
     def get(self, message: Message) -> Message:
+        self._node_last_contact[message.node_id] = time.time()
         request = (
             comm.BaseRequest.deserialize(message.data)
             if message.data
@@ -111,6 +119,7 @@ class MasterServicer(MasterService):
         return Message(node_id=message.node_id, data=response.serialize())
 
     def report(self, message: Message) -> Message:
+        self._node_last_contact[message.node_id] = time.time()
         request = (
             comm.BaseRequest.deserialize(message.data)
             if message.data

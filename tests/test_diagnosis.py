@@ -1,0 +1,312 @@
+"""Diagnosis subsystem tests: diagnosticians, manager, pre-check,
+DiagnosisMaster, and the node-side DiagnosisAgent.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    NodeStatus,
+    NodeType,
+    PreCheckStatus,
+)
+from dlrover_tpu.agent.diagnosis_agent import (
+    DiagnosisAgent,
+    FailureContext,
+    WorkerAction,
+)
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.diagnosis.diagnosis_data import (
+    DiagnosisDataType,
+    TrainingLog,
+    build_diagnosis_data,
+)
+from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
+from dlrover_tpu.diagnosis.diagnostician import Diagnostician, Observation
+from dlrover_tpu.diagnosis.diagnosticians.node_failure import (
+    NodeFailureDiagnostician,
+    NodeInconsistencyDiagnostician,
+)
+from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+    TrainingHangDiagnostician,
+)
+from dlrover_tpu.diagnosis.precheck import (
+    ConnectionPreCheckOperator,
+    PreCheckResult,
+    SchedulingPreCheckOperator,
+)
+from dlrover_tpu.diagnosis.actions import EventAction, NoAction
+from dlrover_tpu.master.diagnosis.diagnosis_master import DiagnosisMaster
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+# ---- hang diagnostician -----------------------------------------------------
+
+
+def test_hang_diagnostician_escalates():
+    perf = PerfMonitor()
+    d = TrainingHangDiagnostician(
+        perf, hang_timeout_s=0.1, restart_after_s=0.3
+    )
+    # No steps yet: healthy.
+    assert isinstance(d.diagnose(), NoAction)
+    perf.collect_global_step(10, time.time())
+    time.sleep(0.15)
+    # Stagnated but young: event only.
+    action = d.diagnose()
+    assert isinstance(action, EventAction)
+    time.sleep(0.3)
+    action = d.diagnose()
+    assert action.action_type == DiagnosisActionType.JOB_RESTART
+
+
+def test_hang_clears_on_progress():
+    perf = PerfMonitor()
+    d = TrainingHangDiagnostician(
+        perf, hang_timeout_s=0.2, restart_after_s=10.0
+    )
+    perf.collect_global_step(10, time.time())
+    time.sleep(0.25)
+    assert isinstance(d.diagnose(), EventAction)
+    perf.collect_global_step(11, time.time())
+    assert isinstance(d.diagnose(), NoAction)
+
+
+# ---- node failure diagnosticians -------------------------------------------
+
+
+def test_node_failure_budget():
+    d = NodeFailureDiagnostician(max_total_failures=3)
+    ctx = get_job_context()
+    assert isinstance(d.diagnose(), NoAction)
+    for _ in range(3):
+        ctx.inc_failure_count()
+    assert d.diagnose().action_type == DiagnosisActionType.JOB_ABORT
+
+
+def test_node_inconsistency():
+    ctx = get_job_context()
+    node = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+    node.reported_status = NodeStatus.SUCCEEDED
+    ctx.update_node(node)
+    d = NodeInconsistencyDiagnostician()
+    action = d.diagnose()
+    assert isinstance(action, EventAction)
+    assert "worker-0" in action.event_msg
+
+
+# ---- manager ----------------------------------------------------------------
+
+
+def test_manager_enqueues_actions():
+    class Always(Diagnostician):
+        observe_interval_s = 0.01
+
+        def observe(self, **kw):
+            return Observation("problem")
+
+        def resolve(self, ob, **kw):
+            return EventAction(event_msg="seen", instance=-1)
+
+    mgr = DiagnosisManager(tick_s=0.01)
+    mgr.register(Always())
+    mgr.diagnose_once()
+    action = get_job_context().next_master_action()
+    assert action is not None and action.event_msg == "seen"
+
+
+# ---- pre-check --------------------------------------------------------------
+
+
+class _FakeWorkerManager:
+    def __init__(self, pending):
+        self._pending = pending
+
+    def pending_nodes(self):
+        return self._pending
+
+
+class _FakeJobManager:
+    def __init__(self, pending):
+        self.worker_manager = _FakeWorkerManager(pending)
+
+
+def test_scheduling_precheck():
+    op = SchedulingPreCheckOperator(_FakeJobManager([]), timeout_s=0.1)
+    assert op.run_with_retries().passed
+    pending = [Node(NodeType.WORKER, 5, status=NodeStatus.PENDING)]
+    op = SchedulingPreCheckOperator(_FakeJobManager(pending), timeout_s=0.1)
+    op.retry_interval_s = 0.02
+    result = op.run_with_retries()
+    assert not result.passed and result.abnormal_nodes == [5]
+
+
+def test_connection_precheck():
+    ctx = get_job_context()
+    node = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+    node.heartbeat_time = 0
+    ctx.update_node(node)
+    contacts = {}
+    op = ConnectionPreCheckOperator(lambda: contacts, timeout_s=0.1)
+    op.retry_interval_s = 0.02
+    assert not op.run_with_retries().passed
+    # Any RPC from the node (even just polling the pre-check result)
+    # counts as connected — no heartbeat required.
+    contacts[0] = time.time()
+    assert op.run_with_retries().passed
+
+
+def test_diagnosis_master_precheck_status():
+    class FailOp(SchedulingPreCheckOperator):
+        def __init__(self):
+            self.timeout_s = 0.05
+            self.retry_interval_s = 0.02
+
+        def check(self):
+            return PreCheckResult(passed=False, reason="nope")
+
+    dm = DiagnosisMaster(pre_check_operators=[FailOp()])
+    assert dm.get_pre_check_status() == PreCheckStatus.CHECKING
+    assert not dm.pre_check()
+    assert dm.get_pre_check_status() == PreCheckStatus.FAIL
+
+    dm = DiagnosisMaster()
+    assert dm.get_pre_check_status() == PreCheckStatus.PASS
+
+
+# ---- diagnosis data ---------------------------------------------------------
+
+
+def test_build_diagnosis_data_roundtrip():
+    data = build_diagnosis_data(
+        DiagnosisDataType.TRAINING_LOG,
+        3,
+        {"logs": ["Error: boom"], "node_rank": 1},
+        123.0,
+    )
+    assert isinstance(data, TrainingLog)
+    assert data.logs == ["Error: boom"]
+    assert data.timestamp == 123.0
+    assert build_diagnosis_data("bogus", 0, {}) is None
+    # A payload carrying node_id must not collide with the positional arg.
+    data = build_diagnosis_data(
+        DiagnosisDataType.TRAINING_LOG, 3, {"node_id": 9, "logs": ["x"]}
+    )
+    assert data.node_id == 3 and data.logs == ["x"]
+
+
+def test_diagnosis_master_collects_reports():
+    dm = DiagnosisMaster()
+    dm.collect_diagnosis_data(
+        comm.DiagnosisDataReport(
+            node_id=2,
+            data_type=DiagnosisDataType.TRAINING_METRIC,
+            payload={"global_step": 7, "throughput": 10.5},
+        )
+    )
+    data = dm.node_data(2)
+    assert len(data) == 1 and data[0].global_step == 7
+
+
+# ---- diagnosis agent --------------------------------------------------------
+
+
+def test_diagnose_software_failure_restarts_then_fails():
+    agent = DiagnosisAgent()
+    ctx = FailureContext(
+        exit_codes={0: 1}, restart_count=0, max_restarts=3, log_tail=[]
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RESTART_WORKER
+    ctx = FailureContext(
+        exit_codes={0: 2}, restart_count=3, max_restarts=3, log_tail=[]
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.FAIL_JOB
+
+
+def test_diagnose_hardware_failure_relaunches():
+    agent = DiagnosisAgent()
+    ctx = FailureContext(
+        exit_codes={0: 202}, restart_count=0, max_restarts=3, log_tail=[]
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RELAUNCH_NODE
+
+
+def test_diagnose_hardware_log_signature():
+    agent = DiagnosisAgent()
+    ctx = FailureContext(
+        exit_codes={0: 1},
+        restart_count=0,
+        max_restarts=3,
+        log_tail=["RuntimeError: TPU device unavailable"],
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RELAUNCH_NODE
+
+
+def test_repeated_identical_crash_escalates():
+    agent = DiagnosisAgent()
+    ctx = FailureContext(
+        exit_codes={0: 1}, restart_count=0, max_restarts=10, log_tail=[]
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RESTART_WORKER
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RESTART_WORKER
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RELAUNCH_NODE
+
+
+def test_collect_error_logs(tmp_path):
+    log = tmp_path / "worker.log"
+    log.write_text(
+        "step 1 ok\nstep 2 ok\nTraceback (most recent call last):\n"
+        "ValueError: bad\nstep 3 ok\n"
+    )
+    agent = DiagnosisAgent(log_path=str(log))
+    lines = agent.collect_error_logs()
+    assert any("Traceback" in ln for ln in lines)
+    assert any("ValueError" in ln for ln in lines)
+    assert not any("step 1" in ln for ln in lines)
+
+
+def test_stale_hardware_log_does_not_taint_later_crashes(tmp_path):
+    log = tmp_path / "worker.log"
+    log.write_text("RuntimeError: libtpu init error\n")
+    agent = DiagnosisAgent(log_path=str(log))
+    ctx = FailureContext(
+        exit_codes={0: 1}, restart_count=0, max_restarts=5
+    )
+    # First failure sees the hardware line: relaunch.
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RELAUNCH_NODE
+    # Later software crash with no NEW hardware evidence: plain restart.
+    with open(log, "a") as f:
+        f.write("ValueError: bad input\n")
+    ctx = FailureContext(
+        exit_codes={0: 2}, restart_count=1, max_restarts=5
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.RESTART_WORKER
+
+
+def test_budget_beats_signature_escalation():
+    agent = DiagnosisAgent()
+    # Deterministic crash at the end of the budget fails the job instead
+    # of relaunching onto a fresh host forever.
+    for restart in range(3):
+        ctx = FailureContext(
+            exit_codes={0: 1},
+            restart_count=restart,
+            max_restarts=3,
+            log_tail=[],
+        )
+        agent.diagnose_training_failure(ctx)
+    ctx = FailureContext(
+        exit_codes={0: 1}, restart_count=3, max_restarts=3, log_tail=[]
+    )
+    assert agent.diagnose_training_failure(ctx) == WorkerAction.FAIL_JOB
