@@ -178,8 +178,16 @@ class AsyncCheckpointSaver:
     _instance: Optional["AsyncCheckpointSaver"] = None
     _lock = threading.Lock()
 
-    def __init__(self, client=None, local_world_size: int = _MAX_LOCAL_WORKERS):
+    def __init__(
+        self,
+        client=None,
+        local_world_size: int = _MAX_LOCAL_WORKERS,
+        replica_manager=None,
+    ):
         self._client = client
+        self._replica_manager = replica_manager
+        self._last_replica_step = -1
+        self._replica_inflight = threading.Event()
         self._node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
         self._event_queue = SharedQueueServer(CKPT_EVENT_QUEUE)
         self._locks = [
@@ -200,11 +208,13 @@ class AsyncCheckpointSaver:
 
     @classmethod
     def start_async_saving_ckpt(
-        cls, client=None
+        cls, client=None, replica_manager=None
     ) -> "AsyncCheckpointSaver":
         with cls._lock:
             if cls._instance is None:
-                cls._instance = cls(client=client)
+                cls._instance = cls(
+                    client=client, replica_manager=replica_manager
+                )
             return cls._instance
 
     @classmethod
@@ -234,6 +244,7 @@ class AsyncCheckpointSaver:
     def _handle_event(self, event: SaveEvent):
         if event.kind == SaveEvent.SAVE_MEM:
             self._latest_mem_event = event
+            self._push_replicas(event)
             return
         if event.kind == SaveEvent.SAVE_DISK:
             self._latest_mem_event = event
@@ -248,6 +259,44 @@ class AsyncCheckpointSaver:
             )
             if ok:
                 self._last_persisted_step = event.step
+            self._push_replicas(event)
+
+    def _push_replicas(self, event: SaveEvent):
+        """Replicate this node's shm image to group peers, in the
+        background: uploads of multi-GB images must not stall the saver
+        event loop (breakpoint-save freshness) or, worse, the workers."""
+        if self._replica_manager is None:
+            return
+        if event.step <= self._last_replica_step:
+            return  # save_to_storage emits SAVE_MEM then SAVE_DISK
+        if self._replica_inflight.is_set():
+            logger.info(
+                "replica push still running; skipping step %d", event.step
+            )
+            return
+        self._last_replica_step = event.step
+        self._replica_inflight.set()
+
+        def push():
+            try:
+                self._replica_manager.set_world(self._world_nodes)
+                n = self._replica_manager.push_node_image(
+                    event.local_world_size, locks=self._locks
+                )
+                if n:
+                    logger.info(
+                        "pushed %d shm segment replicas for step %d",
+                        n,
+                        event.step,
+                    )
+            except Exception:
+                logger.exception("replica push failed")
+            finally:
+                self._replica_inflight.clear()
+
+        threading.Thread(
+            target=push, name="ckpt-replica-push", daemon=True
+        ).start()
 
     # ---- failure path ------------------------------------------------------
 

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--log_dir", type=str, default="")
     p.add_argument("--pre_check_timeout", type=float, default=600.0)
+    p.add_argument(
+        "--ckpt_replica_group",
+        type=int,
+        default=1,
+        help="nodes per in-memory checkpoint replica group (1 = off)",
+    )
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -198,7 +204,42 @@ def run(args) -> int:
     )
     from dlrover_tpu.flash_ckpt.saver import AsyncCheckpointSaver
 
-    saver = AsyncCheckpointSaver.start_async_saving_ckpt(client=client)
+    replica_manager = None
+    if args.ckpt_replica_group > 1:
+        from dlrover_tpu.flash_ckpt.replica import CkptReplicaManager
+
+        from dlrover_tpu.common.env_utils import get_hostname_ip
+
+        replica_manager = CkptReplicaManager(
+            node_rank=node_rank,
+            master_client=client,
+            group_size=args.ckpt_replica_group,
+        )
+        # Publish a routable address, not loopback: peers resolve it from
+        # the master KV store.
+        replica_manager.start(advertise_host=get_hostname_ip()[1])
+        try:
+            # A fresh host after relaunch pulls its shm images back from a
+            # peer so workers can do a memory-first restore. Ask every
+            # possible rank: the push-time grouping used the rendezvous
+            # world, which this fresh node cannot reconstruct.
+            restored = replica_manager.restore_missing_segments(
+                args.nproc_per_node,
+                candidate_ranks=list(range(max_nodes)),
+            )
+            if restored:
+                logger.info(
+                    "restored %d shm checkpoint segments from peers",
+                    restored,
+                )
+        except Exception:
+            logger.warning(
+                "replica pull failed; storage restore will be used",
+                exc_info=True,
+            )
+    saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+        client=client, replica_manager=replica_manager
+    )
     agent = ElasticAgent(spec, client, ckpt_saver=saver)
 
     def _signal_handler(signum, frame):

@@ -1,0 +1,111 @@
+"""Cross-host in-memory checkpoint replica tests (two simulated agents
+in one process, distinct HTTP replica services)."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.flash_ckpt.engine import shm_segment_name
+from dlrover_tpu.flash_ckpt.replica import (
+    CkptReplicaManager,
+    restore_segment,
+    snapshot_segment,
+)
+from dlrover_tpu.flash_ckpt.shm_handler import SharedMemoryHandler
+
+
+@pytest.fixture
+def primary_segment():
+    name = shm_segment_name(0)
+    handler = SharedMemoryHandler(name)
+    state = {"w": np.arange(32, dtype=np.float32), "b": np.ones(4)}
+    handler.save_state_dict(7, state, {"process_id": 0})
+    yield name, state
+    SharedMemoryHandler(name).unlink()
+
+
+def test_snapshot_restore_roundtrip(primary_segment):
+    name, state = primary_segment
+    payload = snapshot_segment(name)
+    assert payload is not None
+    SharedMemoryHandler(name).unlink()
+    assert SharedMemoryHandler(name).load_meta() is None
+    restore_segment(name, payload)
+    handler = SharedMemoryHandler(name)
+    step, loaded, meta = handler.load_state_dict()
+    handler.close()
+    assert step == 7
+    np.testing.assert_array_equal(loaded["w"], state["w"])
+    np.testing.assert_array_equal(loaded["b"], state["b"])
+
+
+def test_snapshot_missing_segment_returns_none():
+    assert snapshot_segment("dlrover_tpu_test_nonexistent") is None
+
+
+def make_pair():
+    m0 = CkptReplicaManager(node_rank=0, group_size=2)
+    m1 = CkptReplicaManager(node_rank=1, group_size=2)
+    m0._addr_map = {1: f"127.0.0.1:{m1.port}", 0: f"127.0.0.1:{m0.port}"}
+    m1._addr_map = dict(m0._addr_map)
+    m0.start()
+    m1.start()
+    m0.set_world([0, 1])
+    m1.set_world([0, 1])
+    return m0, m1
+
+
+def test_group_topology():
+    m = CkptReplicaManager(node_rank=2, group_size=2)
+    m.set_world([0, 1, 2, 3, 4])
+    assert m.group_peers() == [3]
+    assert m.group_peers(0) == [1]
+    assert m.group_peers(4) == []  # incomplete trailing group
+    m4 = CkptReplicaManager(node_rank=0, group_size=1)
+    m4.set_world([0, 1])
+    assert m4.group_peers() == []
+    m.stop()
+    m4.stop()
+
+
+def test_push_and_pull_replica(primary_segment):
+    name, state = primary_segment
+    m0, m1 = make_pair()
+    try:
+        # Node 0 pushes its segment to its group peer (node 1).
+        assert m0.push_node_image(local_world_size=1) == 1
+        # Host replacement: node 0 loses its shm.
+        SharedMemoryHandler(name).unlink()
+        assert SharedMemoryHandler(name).load_meta() is None
+        # Relaunched node 0 pulls the segment back from node 1.
+        assert m0.restore_missing_segments(local_world_size=1) == 1
+        handler = SharedMemoryHandler(name)
+        step, loaded, _ = handler.load_state_dict()
+        handler.close()
+        assert step == 7
+        np.testing.assert_array_equal(loaded["w"], state["w"])
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_restore_noop_when_segment_present(primary_segment):
+    m0, m1 = make_pair()
+    try:
+        m0.push_node_image(local_world_size=1)
+        # Segment still present: pull must not overwrite anything.
+        assert m0.restore_missing_segments(local_world_size=1) == 0
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_pull_without_peer_replica(primary_segment):
+    name, _ = primary_segment
+    m0, m1 = make_pair()
+    try:
+        SharedMemoryHandler(name).unlink()
+        # No push happened: pull finds nothing, restores nothing.
+        assert m0.restore_missing_segments(local_world_size=1) == 0
+    finally:
+        m0.stop()
+        m1.stop()
