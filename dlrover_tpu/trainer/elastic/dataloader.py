@@ -1,0 +1,55 @@
+"""Elastic data loader: batches that keep the global batch fixed.
+
+Parity: reference trainer/torch/elastic/dataloader.py (ElasticDataLoader)
+— rebuilt around host-side numpy batching for JAX: the loader yields
+stacked numpy batches selected by an ElasticDistributedSampler (static
+split) or an IndexShardingClient (master-driven dynamic shards).
+"""
+
+import math
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+
+class ElasticDataLoader:
+    def __init__(
+        self,
+        fetch_record: Callable[[int], dict],
+        sampler: ElasticDistributedSampler,
+        per_host_batch_size: int,
+    ):
+        """``fetch_record(index) -> dict of np arrays`` is the user's
+        record accessor (memory-mapped file, array slice, ...)."""
+        self._fetch = fetch_record
+        self.sampler = sampler
+        self.per_host_batch_size = per_host_batch_size
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.per_host_batch_size * self.sampler.world_size
+
+    def __iter__(self) -> Iterator[dict]:
+        batch = []
+        for index in self.sampler:
+            batch.append(self._fetch(index))
+            if len(batch) == self.per_host_batch_size:
+                # Advance the cursor BEFORE yielding: a checkpoint taken
+                # after training on this batch must count it, or resume
+                # would replay the same records.
+                self.sampler.record_batch(self.global_batch_size)
+                yield self._stack(batch)
+                batch = []
+        # Trailing partial batch dropped: static shapes keep XLA happy.
+
+    def __len__(self) -> int:
+        return len(self.sampler) // self.per_host_batch_size
+
+    @staticmethod
+    def _stack(records) -> dict:
+        keys = records[0].keys()
+        return {
+            k: np.stack([np.asarray(r[k]) for r in records]) for k in keys
+        }

@@ -1,0 +1,132 @@
+"""Worker-side dynamic data sharding client.
+
+Parity: reference dlrover/python/elastic_agent/sharding/client.py
+(ShardingClient:29, IndexShardingClient:232) — workers pull record-range
+tasks from the master's TaskManager instead of statically partitioning
+the dataset, so shards owned by a dead/slow worker are re-dispatched and
+elasticity needs no data re-splitting.
+"""
+
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import logger
+
+
+class ShardingClient:
+    """Task-granular client: fetch a shard, process it, report done."""
+
+    def __init__(
+        self,
+        master_client,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        task_type: str = "training",
+    ):
+        self._client = master_client
+        self.dataset_name = dataset_name
+        self._current_task: Optional[comm.ShardTask] = None
+        # Idempotent on the master: every worker reports the params, the
+        # first one creates the dataset.
+        self._client.report_dataset_shard_params(
+            comm.DatasetShardParams(
+                dataset_name=dataset_name,
+                dataset_size=dataset_size,
+                shard_size=shard_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+                task_type=task_type,
+            )
+        )
+
+    def fetch_task(self) -> Optional[comm.ShardTask]:
+        """Next shard, or None when the dataset is exhausted.
+
+        A WAIT response (peers hold the remaining shards in flight) polls
+        until the master either re-dispatches a recovered shard or
+        declares the dataset done — returning early would orphan shards
+        re-queued after a peer failure.
+        """
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task is None:
+                return None
+            if task.task_type == TaskType.WAIT:
+                time.sleep(2.0)
+                continue
+            if task.task_id < 0:
+                return None
+            self._current_task = task
+            return task
+
+    def report_task_done(self, task: Optional[comm.ShardTask] = None):
+        task = task or self._current_task
+        if task is not None:
+            self._client.report_task_done(self.dataset_name, task.task_id)
+
+    # ---- shard checkpoint (dataset position survives restarts) ------------
+
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore_shard_checkpoint(self, checkpoint: str):
+        if checkpoint:
+            self._client.restore_shard_checkpoint(
+                self.dataset_name, checkpoint
+            )
+
+
+class IndexShardingClient(ShardingClient):
+    """Record-granular iterator: hides tasks behind ``next index``.
+
+    Fetches one task at a time from the master, synchronously at shard
+    boundaries; iteration ends when the master reports the dataset done.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._indices: "queue.Queue[int]" = queue.Queue()
+        self._records_consumed = 0
+        self._records_in_task = 0
+        self._lock = threading.Lock()
+
+    def fetch_record_index(self) -> Optional[int]:
+        """Next global record index, or None at end of data."""
+        with self._lock:
+            if self._indices.empty():
+                if not self._fill_from_next_task():
+                    return None
+            index = self._indices.get()
+            self._records_consumed += 1
+            self._records_in_task -= 1
+            if self._records_in_task == 0 and self._current_task:
+                self.report_task_done(self._current_task)
+        return index
+
+    def _fill_from_next_task(self) -> bool:
+        task = self.fetch_task()
+        if task is None:
+            return False
+        indices: List[int] = (
+            task.record_indices
+            if task.record_indices
+            else list(range(task.start, task.end))
+        )
+        for i in indices:
+            self._indices.put(i)
+        self._records_in_task = len(indices)
+        return bool(indices)
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            index = self.fetch_record_index()
+            if index is None:
+                return
+            yield index

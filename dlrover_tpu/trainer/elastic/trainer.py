@@ -1,0 +1,99 @@
+"""Elastic trainer: fixed global batch across world-size changes.
+
+Parity: reference trainer/torch/elastic/trainer.py (ElasticTrainer:336)
+— the training semantics (global batch, LR schedule) must not depend on
+how many hosts happen to be alive. JAX version: the global batch is
+``micro_batch_per_device x dp_size x grad_accum``; on re-mesh the trainer
+recomputes grad_accum for the new dp size and the train step's
+``lax.scan`` accumulation loop absorbs the difference — no optimizer or
+schedule surgery.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class ElasticBatchConfig:
+    global_batch_size: int
+    micro_batch_per_device: int
+
+    def grad_accum_for(self, dp_size: int) -> int:
+        """Microbatch steps per update for a data-parallel size."""
+        denom = self.micro_batch_per_device * dp_size
+        if denom <= 0 or self.global_batch_size % denom != 0:
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by "
+                f"micro({self.micro_batch_per_device}) x dp({dp_size})"
+            )
+        return self.global_batch_size // denom
+
+
+class ElasticTrainer:
+    """Step/epoch bookkeeping + master perf reporting around a jitted
+    train step whose grad_accum tracks the live world."""
+
+    def __init__(
+        self,
+        batch_config: ElasticBatchConfig,
+        dp_size: int,
+        master_client=None,
+        report_interval_s: float = 15.0,
+    ):
+        self.batch_config = batch_config
+        self.dp_size = dp_size
+        self.grad_accum = batch_config.grad_accum_for(dp_size)
+        self._client = master_client
+        self._report_interval_s = report_interval_s
+        self.global_step = 0
+        self._train_started = 0.0
+        self._last_report = 0.0
+
+    # ---- re-scale ------------------------------------------------------------
+
+    def rescale(self, dp_size: int) -> bool:
+        """Adopt a new data-parallel size; True if grad_accum changed
+        (caller must rebuild its jitted step with the new accumulation)."""
+        new_accum = self.batch_config.grad_accum_for(dp_size)
+        changed = new_accum != self.grad_accum
+        if changed:
+            logger.info(
+                "elastic re-scale: dp %d -> %d, grad_accum %d -> %d "
+                "(global batch stays %d)",
+                self.dp_size,
+                dp_size,
+                self.grad_accum,
+                new_accum,
+                self.batch_config.global_batch_size,
+            )
+        self.dp_size = dp_size
+        self.grad_accum = new_accum
+        return changed
+
+    # ---- step bookkeeping ----------------------------------------------------
+
+    def start_training(self):
+        self._train_started = time.time()
+
+    def step_completed(self, steps: int = 1):
+        self.global_step += steps
+        now = time.time()
+        if (
+            self._client is not None
+            and now - self._last_report > self._report_interval_s
+        ):
+            self._last_report = now
+            elapsed = now - self._train_started if self._train_started else 0
+            try:
+                self._client.report_global_step(
+                    self.global_step, elapsed_train_secs=elapsed
+                )
+            except Exception:
+                logger.warning("global step report failed", exc_info=True)
+
+    def epoch_of(self, dataset_size: int) -> int:
+        consumed = self.global_step * self.batch_config.global_batch_size
+        return consumed // max(dataset_size, 1)

@@ -1,0 +1,206 @@
+"""Elastic trainer library tests: sampler resume/rescale, dataloader,
+fixed-global-batch trainer, and the dynamic sharding client against a
+real in-process master."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+from dlrover_tpu.trainer.elastic.sharding_client import (
+    IndexShardingClient,
+    ShardingClient,
+)
+from dlrover_tpu.trainer.elastic.trainer import (
+    ElasticBatchConfig,
+    ElasticTrainer,
+)
+
+
+# ---- sampler ----------------------------------------------------------------
+
+
+def test_sampler_partitions_world():
+    samplers = [
+        ElasticDistributedSampler(100, rank=r, world_size=4, shuffle=False)
+        for r in range(4)
+    ]
+    seen = sorted(i for s in samplers for i in s)
+    assert seen == list(range(100))
+
+
+def test_sampler_shuffle_deterministic_per_epoch():
+    s1 = ElasticDistributedSampler(50, 0, 1, shuffle=True, seed=7)
+    s2 = ElasticDistributedSampler(50, 0, 1, shuffle=True, seed=7)
+    assert list(s1) == list(s2)
+    s1.set_epoch(1)
+    s2.set_epoch(0)
+    assert list(s1) != list(s2)
+
+
+def test_sampler_resume_skips_consumed():
+    s = ElasticDistributedSampler(100, 0, 2, shuffle=False)
+    s.record_batch(40)  # 40 records consumed globally
+    state = s.state_dict()
+
+    s2 = ElasticDistributedSampler(100, 0, 2, shuffle=False)
+    s2.load_state_dict(state)
+    first = next(iter(s2))
+    assert first == 40  # rank 0 of the remaining [40..100)
+
+
+def test_sampler_rescale_redistributes_remainder():
+    # 2-rank world consumes 40, then re-scales to 3 ranks.
+    s = ElasticDistributedSampler(100, 0, 2, shuffle=False)
+    s.record_batch(40)
+    state = s.state_dict()
+
+    new = [
+        ElasticDistributedSampler(100, r, 3, shuffle=False) for r in range(3)
+    ]
+    for smp in new:
+        smp.load_state_dict(state)
+    seen = sorted(i for smp in new for i in smp)
+    assert seen == list(range(40, 100))
+
+
+def test_sampler_drop_last():
+    s = ElasticDistributedSampler(10, 0, 4, shuffle=False, drop_last=True)
+    assert len(list(s)) == 2  # 8 usable, 2 per rank
+
+
+# ---- dataloader -------------------------------------------------------------
+
+
+def test_dataloader_batches_and_advances_cursor():
+    data = np.arange(64).reshape(64, 1)
+    sampler = ElasticDistributedSampler(64, 0, 2, shuffle=False)
+    loader = ElasticDataLoader(
+        lambda i: {"x": data[i]}, sampler, per_host_batch_size=4
+    )
+    batches = list(loader)
+    # 64 records / world 2 = 32 per host / 4 = 8 batches
+    assert len(batches) == 8
+    assert batches[0]["x"].shape == (4, 1)
+    # Cursor advanced by 8 global batches of 8 records.
+    assert sampler.state_dict()["completed"] == 64
+
+
+# ---- elastic trainer --------------------------------------------------------
+
+
+def test_fixed_global_batch_across_rescale():
+    cfg = ElasticBatchConfig(
+        global_batch_size=64, micro_batch_per_device=2
+    )
+    tr = ElasticTrainer(cfg, dp_size=8)
+    assert tr.grad_accum == 4  # 64 / (2*8)
+    changed = tr.rescale(4)  # lost half the slice
+    assert changed and tr.grad_accum == 8  # 64 / (2*4)
+    assert not tr.rescale(4)
+
+
+def test_bad_global_batch_rejected():
+    cfg = ElasticBatchConfig(global_batch_size=10, micro_batch_per_device=3)
+    with pytest.raises(ValueError):
+        ElasticTrainer(cfg, dp_size=2)
+
+
+def test_epoch_accounting():
+    cfg = ElasticBatchConfig(global_batch_size=32, micro_batch_per_device=2)
+    tr = ElasticTrainer(cfg, dp_size=4)
+    tr.global_step = 10
+    assert tr.epoch_of(dataset_size=100) == 3  # 320 records / 100
+
+
+# ---- sharding client (real master) ------------------------------------------
+
+
+@pytest.fixture()
+def master():
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    JobContext.reset_singleton()
+    m = LocalJobMaster(port=0, node_num=1)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(f"localhost:{master.port}", node_id=0)
+    assert c.wait_master_ready(30)
+    yield c
+    c.close()
+
+
+def test_sharding_client_round_trip(master, client):
+    sc = ShardingClient(
+        client, "train-ds", dataset_size=100, shard_size=30
+    )
+    sizes = []
+    while True:
+        task = sc.fetch_task()
+        if task is None:
+            break
+        sizes.append(task.end - task.start)
+        sc.report_task_done(task)
+    assert sum(sizes) == 100
+    assert master.task_manager.finished()
+
+
+def test_index_sharding_client_iterates_all(master, client):
+    isc = IndexShardingClient(
+        client, "idx-ds", dataset_size=25, shard_size=10
+    )
+    indices = sorted(isc)
+    assert indices == list(range(25))
+
+
+def test_shard_checkpoint_roundtrip(master, client):
+    sc = ShardingClient(client, "ckpt-ds", dataset_size=40, shard_size=10)
+    t1 = sc.fetch_task()
+    ckpt = sc.get_shard_checkpoint()
+    assert ckpt
+    # Simulate restart: restore, the unfinished shard is re-dispatched.
+    sc2 = ShardingClient(client, "ckpt-ds", dataset_size=40, shard_size=10)
+    sc2.restore_shard_checkpoint(ckpt)
+    seen = 0
+    while True:
+        task = sc2.fetch_task()
+        if task is None:
+            break
+        seen += task.end - task.start
+        sc2.report_task_done(task)
+    assert seen == 40
+
+
+def test_fetch_task_polls_through_wait(master, client):
+    """A worker must not treat WAIT (peers hold in-flight shards) as
+    end-of-dataset: it polls until re-dispatch or completion."""
+    import threading
+    import time as _time
+
+    sc_a = ShardingClient(client, "wait-ds", dataset_size=5, shard_size=5)
+    task_a = sc_a.fetch_task()
+    assert task_a is not None
+
+    c2 = MasterClient(f"localhost:{master.port}", node_id=1)
+    sc_b = ShardingClient(c2, "wait-ds", dataset_size=5, shard_size=5)
+    result = {}
+
+    def fetch_b():
+        result["task"] = sc_b.fetch_task()
+
+    t = threading.Thread(target=fetch_b, daemon=True)
+    t.start()
+    _time.sleep(0.3)
+    assert t.is_alive()  # polling through WAIT, not returning None
+    sc_a.report_task_done(task_a)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert result["task"] is None  # dataset completed
+    c2.close()

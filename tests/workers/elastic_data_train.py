@@ -1,0 +1,36 @@
+"""E2E worker: iterates master-dispatched dynamic data shard indices via
+IndexShardingClient under the run CLI and records which indices it saw.
+Each process writes its own file (out_path.<process_id>) in one flush so
+multi-worker runs can be checked without interleaving artifacts."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.env_utils import get_master_addr
+from dlrover_tpu.trainer.elastic.sharding_client import IndexShardingClient
+from dlrover_tpu.trainer.runtime import init_distributed
+
+
+def main():
+    dataset_size = int(sys.argv[1])
+    out_path = sys.argv[2]
+
+    ctx = init_distributed()
+    client = MasterClient(get_master_addr(), node_id=ctx.process_id)
+    isc = IndexShardingClient(
+        client,
+        "e2e-ds",
+        dataset_size=dataset_size,
+        shard_size=7,
+        shuffle=False,
+    )
+    seen = sorted(isc)
+    with open(f"{out_path}.{ctx.process_id}", "w") as f:
+        f.write("".join(f"{i}\n" for i in seen))
+
+
+if __name__ == "__main__":
+    main()
