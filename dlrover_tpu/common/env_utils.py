@@ -14,6 +14,13 @@ def get_env_int(name: str, default: int = 0) -> int:
         return default
 
 
+def get_env_bool(name: str, default: bool = False) -> bool:
+    value = os.getenv(name, "")
+    if not value:
+        return default
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
 def get_env_str(name: str, default: str = "") -> str:
     return os.getenv(name, default)
 

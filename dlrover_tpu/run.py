@@ -27,7 +27,7 @@ from dlrover_tpu.common.constants import (
     NodeEnv,
     PreCheckStatus,
 )
-from dlrover_tpu.common.env_utils import get_env_int
+from dlrover_tpu.common.env_utils import get_env_bool, get_env_int
 from dlrover_tpu.common.log import logger
 
 
@@ -191,6 +191,21 @@ def run(args) -> int:
     monitor = ResourceMonitor(client)
     monitor.start()
 
+    timer_collectors = []
+    if get_env_bool("DLROVER_TPU_TIMER"):
+        from dlrover_tpu.diagnosis.collectors import TpuTimerMetricCollector
+        from dlrover_tpu.tpu_timer.bridge import port_file_path
+
+        for local_rank in range(args.nproc_per_node):
+            c = TpuTimerMetricCollector(
+                master_client=client,
+                node_id=node_rank,
+                port=18889 + local_rank,
+                port_file=port_file_path(local_rank),
+            )
+            c.start()
+            timer_collectors.append(c)
+
     spec = WorkerSpec(
         entrypoint=args.training_script,
         args=list(args.training_script_args),
@@ -251,6 +266,8 @@ def run(args) -> int:
 
     result = agent.run()
     monitor.stop()
+    for c in timer_collectors:
+        c.stop()
     if result == RunResult.SUCCEEDED:
         code = 0
     elif result == RunResult.RELAUNCH:

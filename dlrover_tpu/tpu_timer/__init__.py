@@ -1,0 +1,5 @@
+from dlrover_tpu.tpu_timer.bridge import (  # noqa: F401
+    SpanKind,
+    TpuTimer,
+    get_timer,
+)

@@ -1,0 +1,202 @@
+"""ctypes bridge to the native tpu_timer runtime (libtpu_timer.so).
+
+Parity: reference xpu_timer's py side (py_xpu_timer) + the
+LD_PRELOAD hook layer (nvidia/hook.cc). On TPU there is no dlsym-able
+NCCL: spans are fed explicitly from Python at the natural sync points
+(jitted step dispatch, XLA compiles, checkpoint phases, collective
+probes), while everything that must survive a wedged Python runtime —
+trace ring, aggregation, Prometheus daemon, hang watchdog — is native.
+
+The library is built on first use if missing (one g++ invocation, no
+third-party deps) and cached next to the sources.
+"""
+
+import ctypes
+import fcntl
+import os
+import subprocess
+import tempfile
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "tpu_timer",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtpu_timer.so")
+
+
+class SpanKind:
+    STEP = 0
+    COMPILE = 1
+    CHECKPOINT = 2
+    COLLECTIVE = 3
+    DATA = 4
+    CUSTOM = 9
+
+
+def port_file_path(local_rank: int) -> str:
+    """Where a worker publishes its daemon's actually-bound port (the
+    launcher-side collector re-reads it before each scrape)."""
+    job = os.getenv(NodeEnv.JOB_NAME, "job")
+    return os.path.join(
+        tempfile.gettempdir(), f"dlrover_tpu_timer_{job}_{local_rank}.port"
+    )
+
+
+def publish_port(local_rank: int, port: int):
+    path = port_file_path(local_rank)
+    tmp = f"{path}.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.rename(tmp, path)
+
+
+def _ensure_built() -> str:
+    if os.path.exists(_SO_PATH):
+        return _SO_PATH
+    # Serialize concurrent first-use builds across worker processes: make
+    # writes the .so in place, and a sibling must not dlopen a half-
+    # written ELF.
+    lock_path = os.path.join(
+        tempfile.gettempdir(), "dlrover_tpu_timer_build.lock"
+    )
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(_SO_PATH):
+                logger.info("building libtpu_timer.so (first use)")
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                )
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _SO_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_ensure_built())
+    lib.tt_init.argtypes = [ctypes.c_int64]
+    lib.tt_start_server.argtypes = [ctypes.c_int]
+    lib.tt_start_server.restype = ctypes.c_int
+    lib.tt_begin.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tt_begin.restype = ctypes.c_int64
+    lib.tt_end.argtypes = [ctypes.c_int64, ctypes.c_double]
+    lib.tt_record.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_double,
+    ]
+    lib.tt_set_gauge.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.tt_counter_add.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.tt_hang_count.restype = ctypes.c_int
+    lib.tt_now_ns.restype = ctypes.c_int64
+    lib.tt_dump_timeline.argtypes = [ctypes.c_char_p]
+    lib.tt_metrics_text.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tt_metrics_text.restype = ctypes.c_int
+    return lib
+
+
+class TpuTimer:
+    """Process-wide profiler handle (native singleton underneath)."""
+
+    _instance: Optional["TpuTimer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, hang_timeout_s: float = 600.0):
+        self._lib = _load_lib()
+        self._lib.tt_init(int(hang_timeout_s * 1000))
+        self.port = 0
+
+    @classmethod
+    def get(cls) -> "TpuTimer":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # ---- daemon -------------------------------------------------------------
+
+    def start_server(self, port: int = 0) -> int:
+        """Start the metrics/timeline HTTP daemon; returns the bound port
+        (reference xpu_timer daemon :18889)."""
+        self.port = self._lib.tt_start_server(port)
+        if self.port:
+            logger.info("tpu_timer daemon on port %d", self.port)
+        return self.port
+
+    # ---- spans --------------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, kind: int = SpanKind.CUSTOM, flops: float = 0.0):
+        sid = self._lib.tt_begin(name.encode(), kind)
+        try:
+            yield
+        finally:
+            self._lib.tt_end(sid, flops)
+
+    def record(
+        self,
+        name: str,
+        kind: int,
+        start_ns: int,
+        dur_ns: int,
+        flops: float = 0.0,
+    ):
+        self._lib.tt_record(name.encode(), kind, start_ns, dur_ns, flops)
+
+    def timed_step(self, step_fn, name: str = "train_step",
+                   flops_per_step: float = 0.0):
+        """Wrap a jitted step: blocks on the result so the span covers
+        device execution (the TPU analogue of CUDA-event timing)."""
+        import jax
+
+        def wrapped(*args, **kwargs):
+            sid = self._lib.tt_begin(name.encode(), SpanKind.STEP)
+            try:
+                out = step_fn(*args, **kwargs)
+                out = jax.block_until_ready(out)
+                return out
+            finally:
+                self._lib.tt_end(sid, flops_per_step)
+
+        return wrapped
+
+    # ---- metrics ------------------------------------------------------------
+
+    def set_gauge(self, name: str, value: float):
+        self._lib.tt_set_gauge(name.encode(), value)
+
+    def counter_add(self, name: str, delta: float = 1.0):
+        self._lib.tt_counter_add(name.encode(), delta)
+
+    def hang_count(self) -> int:
+        return self._lib.tt_hang_count()
+
+    def now_ns(self) -> int:
+        return self._lib.tt_now_ns()
+
+    def metrics_text(self) -> str:
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tt_metrics_text(buf, cap)
+            if n >= 0:
+                return buf.value.decode()
+            cap = -n + 1
+
+    def dump_timeline(self, path: str) -> bool:
+        return self._lib.tt_dump_timeline(path.encode()) == 0
+
+
+def get_timer() -> TpuTimer:
+    return TpuTimer.get()

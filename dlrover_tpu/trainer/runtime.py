@@ -76,8 +76,33 @@ def init_distributed(timeout_secs: int = 300) -> DistributedContext:
             initialization_timeout=timeout_secs,
         )
         ctx.initialized_jax_distributed = True
+    _maybe_start_tpu_timer(ctx)
     _context = ctx
     return ctx
+
+
+def _maybe_start_tpu_timer(ctx: DistributedContext):
+    """Start the native profiler daemon when enabled (reference xpu_timer
+    daemon at :18889; here BASE_PORT + local_rank per worker process).
+    The actually-bound port is published to a port file the launcher-side
+    collector re-reads, so an OS-assigned fallback port still gets
+    scraped."""
+    from dlrover_tpu.common.env_utils import get_env_bool
+
+    if not get_env_bool("DLROVER_TPU_TIMER"):
+        return
+    try:
+        from dlrover_tpu.tpu_timer import get_timer
+        from dlrover_tpu.tpu_timer.bridge import publish_port
+
+        timer = get_timer()
+        port = timer.start_server(18889 + ctx.local_rank)
+        if not port:  # port taken (e.g. stale process): let the OS pick
+            port = timer.start_server(0)
+        if port:
+            publish_port(ctx.local_rank, port)
+    except Exception:
+        logger.warning("tpu_timer daemon failed to start", exc_info=True)
 
 
 def get_context() -> DistributedContext:

@@ -1,0 +1,154 @@
+"""Native tpu_timer tests: build, spans, metrics, daemon, hang watchdog,
+timeline dump, and the agent-side Prometheus collector."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.diagnosis.collectors import (
+    TpuTimerMetricCollector,
+    parse_prometheus_text,
+)
+from dlrover_tpu.tpu_timer import SpanKind, get_timer
+
+
+@pytest.fixture(scope="module")
+def timer():
+    t = get_timer()
+    t.start_server(0)
+    return t
+
+
+def test_span_records_metrics(timer):
+    with timer.span("unit_span", SpanKind.CUSTOM, flops=2e9):
+        time.sleep(0.01)
+    text = timer.metrics_text()
+    assert 'tpu_timer_span_count{name="unit_span"} 1' in text
+    assert 'tpu_timer_tflops{name="unit_span"}' in text
+    metrics = parse_prometheus_text(text)
+    # ~10ms sleep: avg between 5ms and 500ms
+    avg = metrics["tpu_timer_span_avg_us/unit_span"]
+    assert 5_000 < avg < 500_000
+
+
+def test_gauges_and_counters(timer):
+    timer.set_gauge("goodput", 95.5)
+    timer.counter_add("steps", 3)
+    timer.counter_add("steps", 2)
+    metrics = parse_prometheus_text(timer.metrics_text())
+    assert metrics["tpu_timer_gauge/goodput"] == pytest.approx(95.5)
+    assert metrics["tpu_timer_counter/steps"] == pytest.approx(5.0)
+
+
+def test_http_daemon_serves_metrics(timer):
+    conn = http.client.HTTPConnection("127.0.0.1", timer.port, timeout=5)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = resp.read().decode()
+    assert "tpu_timer_hang_spans" in body
+    conn.close()
+
+    conn = http.client.HTTPConnection("127.0.0.1", timer.port, timeout=5)
+    conn.request("GET", "/healthz")
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+def test_hang_watchdog_counts_stuck_spans(timer):
+    # Private timer config: spans older than the timeout count as hung.
+    timer._lib.tt_init(50)  # 50ms hang timeout
+    sid = timer._lib.tt_begin(b"stuck_span", SpanKind.STEP)
+    time.sleep(0.15)
+    assert timer.hang_count() >= 1
+    timer._lib.tt_end(sid, 0.0)
+    assert timer.hang_count() == 0
+    timer._lib.tt_init(600000)  # restore
+
+
+def test_timeline_dump_chrome_trace(timer, tmp_path):
+    with timer.span("timeline_span"):
+        time.sleep(0.001)
+    path = str(tmp_path / "timeline.json")
+    assert timer.dump_timeline(path)
+    with open(path) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "timeline_span" in names
+    ev = [e for e in trace["traceEvents"] if e["name"] == "timeline_span"][0]
+    assert ev["ph"] == "X" and ev["dur"] > 0
+
+
+def test_timed_step_wrapper(timer):
+    import jax.numpy as jnp
+
+    def step(x):
+        return x * 2
+
+    wrapped = timer.timed_step(step, name="wrapped_step", flops_per_step=100)
+    out = wrapped(jnp.ones(4))
+    assert float(out[0]) == 2.0
+    metrics = parse_prometheus_text(timer.metrics_text())
+    assert metrics["tpu_timer_span_count/wrapped_step"] >= 1
+
+
+def test_concurrent_spans(timer):
+    def worker(i):
+        for _ in range(50):
+            with timer.span(f"thread_span_{i % 4}"):
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    metrics = parse_prometheus_text(timer.metrics_text())
+    total = sum(
+        v
+        for k, v in metrics.items()
+        if k.startswith("tpu_timer_span_count/thread_span_")
+    )
+    assert total == 400
+
+
+def test_collector_scrape_and_parse(timer):
+    collector = TpuTimerMetricCollector(port=timer.port)
+    metrics = collector.scrape()
+    assert metrics is not None
+    assert "tpu_timer_hang_spans" in metrics
+
+
+def test_collector_reports_to_client(timer):
+    class FakeClient:
+        def __init__(self):
+            self.reports = []
+
+        def report_diagnosis_data(self, data_type, payload):
+            self.reports.append((data_type, payload))
+
+    client = FakeClient()
+    collector = TpuTimerMetricCollector(
+        master_client=client, node_id=3, port=timer.port
+    )
+    assert collector.collect_once()
+    data_type, payload = client.reports[0]
+    assert "metrics" in payload and payload["node_rank"] == 3
+
+
+def test_span_name_sanitized_for_json(timer, tmp_path):
+    # Quotes/backslashes in user-supplied span names must not break the
+    # chrome-trace JSON or Prometheus label values.
+    with timer.span('restore "ckpt\\shard0"'):
+        pass
+    path = str(tmp_path / "sanitized.json")
+    assert timer.dump_timeline(path)
+    with open(path) as f:
+        trace = json.load(f)  # must parse
+    assert any("restore" in e["name"] for e in trace["traceEvents"])
+    parse_prometheus_text(timer.metrics_text())  # must not blow up
