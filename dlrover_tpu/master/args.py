@@ -28,6 +28,18 @@ def build_master_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--pre_check", action="store_true", default=False)
     parser.add_argument("--network_check", action="store_true", default=False)
+    parser.add_argument(
+        "--auto_scale",
+        action="store_true",
+        default=False,
+        help="enable the throughput-driven worker auto-scaler",
+    )
+    parser.add_argument(
+        "--legal_worker_counts",
+        type=str,
+        default="",
+        help="comma-separated legal worker counts (mesh shapes), e.g. 1,2,4,8",
+    )
     return parser
 
 

@@ -50,6 +50,8 @@ class DistributedJobMaster:
         pending_timeout_s: float = 900.0,
         with_diagnosis: bool = True,
         pre_check: bool = False,
+        auto_scale: bool = False,
+        legal_worker_counts=None,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -92,6 +94,31 @@ class DistributedJobMaster:
         self._node_num = node_num
         self._stopped = threading.Event()
         self.exit_reason = ""
+
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+        self.metric_collector = JobMetricCollector(
+            job_name, self.job_manager, self.perf_monitor
+        )
+        self.auto_scaler = None
+        if auto_scale:
+            from dlrover_tpu.master.node.job_auto_scaler import (
+                AllreduceTrainingAutoScaler,
+            )
+            from dlrover_tpu.master.resource.optimizer import (
+                AllreduceLocalOptimizer,
+            )
+
+            self.auto_scaler = AllreduceTrainingAutoScaler(
+                self.job_manager,
+                scaler,
+                AllreduceLocalOptimizer(
+                    self.job_manager,
+                    self.perf_monitor,
+                    legal_counts=legal_worker_counts,
+                ),
+                rdzv_managers=self.rdzv_managers,
+            )
 
     def _build_diagnosis_master(self, pre_check: bool):
         from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
@@ -156,6 +183,10 @@ class DistributedJobMaster:
             watcher = PodWatcher(args.job_name, args.namespace)
         else:
             raise ValueError(f"unknown platform {args.platform!r}")
+        legal_counts = None
+        raw_counts = getattr(args, "legal_worker_counts", "")
+        if raw_counts:
+            legal_counts = [int(c) for c in raw_counts.split(",") if c]
         return cls(
             port=args.port,
             job_name=args.job_name,
@@ -165,6 +196,8 @@ class DistributedJobMaster:
             max_relaunch_count=args.max_relaunch_count,
             transport=args.transport,
             pre_check=getattr(args, "pre_check", False),
+            auto_scale=getattr(args, "auto_scale", False),
+            legal_worker_counts=legal_counts,
         )
 
     # ---- lifecycle ---------------------------------------------------------
@@ -179,6 +212,9 @@ class DistributedJobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        self.metric_collector.start()
+        if self.auto_scaler is not None:
+            self.auto_scaler.start()
         if self.diagnosis_master is not None:
             self.diagnosis_master.start_observing()
         logger.info(
@@ -240,6 +276,14 @@ class DistributedJobMaster:
 
     def stop(self):
         self._stopped.set()
+        self.metric_collector.report_completion(
+            success=self.exit_reason == JobExitReason.SUCCEEDED,
+            exit_reason=self.exit_reason,
+            failure_count=self._job_context.failure_count,
+        )
+        self.metric_collector.stop()
+        if self.auto_scaler is not None:
+            self.auto_scaler.stop()
         if self.diagnosis_master is not None:
             self.diagnosis_master.stop_observing()
         self.task_manager.stop()

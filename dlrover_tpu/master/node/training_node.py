@@ -81,6 +81,12 @@ class TrainingNodeManager:
         with self._lock:
             new_id = self._new_node_id_fn()
             new_node = node.get_relaunch_node(new_id)
+            # Replacement pods take the group's CURRENT resource template,
+            # not the dead pod's copy: the optimizer may have bumped
+            # memory after an OOM, and the relaunch must pick that up.
+            new_node.config_resource = copy.copy(
+                self._group_resource.node_resource
+            )
             self._nodes[new_id] = new_node
         plan.launch_nodes.append(new_node)
         if not node.is_released:

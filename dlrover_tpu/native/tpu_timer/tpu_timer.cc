@@ -367,7 +367,7 @@ class Manager {
   std::map<std::string, double> gauges_;
   std::map<std::string, double> counters_;
   int64_t hang_timeout_ns_ = 0;
-  bool watchdog_running_ = false;
+  std::atomic<bool> watchdog_running_{false};
   std::thread watchdog_;
   std::thread server_thread_;
   int server_fd_ = -1;

@@ -1,0 +1,251 @@
+"""Resource optimizer, auto-scaler, and job stats tests."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus, NodeType
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_auto_scaler import (
+    AllreduceTrainingAutoScaler,
+)
+from dlrover_tpu.master.node.job_context import JobContext
+from dlrover_tpu.master.resource.optimizer import (
+    AllreduceLocalOptimizer,
+    ResourcePlan,
+)
+from dlrover_tpu.master.stats.job_collector import (
+    JobMetricCollector,
+    LocalStatsReporter,
+)
+from dlrover_tpu.testing.sim_cluster import (
+    SimCluster,
+    SimNodeWatcher,
+    SimScaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_managed_cluster(node_num=2, memory_mb=0.0):
+    cluster = SimCluster()
+    scaler = SimScaler("as-job", cluster)
+    watcher = SimNodeWatcher("as-job", cluster)
+    mgr = DistributedJobManager(
+        job_name="as-job",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=node_num,
+                node_resource=NodeResource(memory_mb=memory_mb),
+            )
+        },
+        scaler=scaler,
+        watcher=watcher,
+    )
+    mgr.start()
+    assert wait_until(
+        lambda: len(
+            [
+                n
+                for n in mgr.worker_manager.nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+        )
+        == node_num
+    )
+    return mgr, scaler, cluster
+
+
+def test_optimizer_scales_up_with_no_counter_evidence():
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        from dlrover_tpu.master.resource.optimizer import _SpeedSample
+
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(
+            mgr, perf, legal_counts=[1, 2, 4, 8], cooldown_s=0.0
+        )
+        # Evidence: current speed at 2 workers, none at 4 yet -> try 4.
+        opt._samples.append(_SpeedSample(2, 1.0, time.time()))
+        plan = opt.generate_plan()
+        assert plan.node_group_resources[NodeType.WORKER].count == 4
+    finally:
+        mgr.stop()
+
+
+def test_optimizer_respects_scaling_efficiency():
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        from dlrover_tpu.master.resource.optimizer import _SpeedSample
+
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(
+            mgr, perf, legal_counts=[2, 4], cooldown_s=0.0,
+            min_scaling_efficiency=0.7,
+        )
+        # Already tried 4 workers: speed only 1.2x at 2x cost (eff 0.6).
+        opt._samples.append(_SpeedSample(2, 1.0, time.time()))
+        opt._samples.append(_SpeedSample(4, 1.2, time.time()))
+        plan = opt.generate_plan()
+        assert plan.empty()
+    finally:
+        mgr.stop()
+
+
+def test_optimizer_without_speed_evidence_stays():
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(
+            mgr, perf, legal_counts=[2, 4], cooldown_s=0.0
+        )
+        assert opt.generate_plan().empty()
+    finally:
+        mgr.stop()
+
+
+def test_oom_bumps_memory():
+    mgr, scaler, cluster = make_managed_cluster(1, memory_mb=1000)
+    try:
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(mgr, perf, cooldown_s=0.0)
+        node = list(mgr.worker_manager.nodes.values())[0]
+        node.exit_reason = NodeExitReason.OOM
+        plan = opt.generate_plan()
+        group = plan.node_group_resources[NodeType.WORKER]
+        assert group.node_resource.memory_mb == pytest.approx(1500)
+    finally:
+        mgr.stop()
+
+
+def test_auto_scaler_executes_plan():
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        class FixedOptimizer:
+            def generate_plan(self):
+                plan = ResourcePlan(comment="test")
+                plan.node_group_resources[NodeType.WORKER] = (
+                    NodeGroupResource(count=4)
+                )
+                return plan
+
+        auto = AllreduceTrainingAutoScaler(
+            mgr, scaler, FixedOptimizer(), interval_s=3600
+        )
+        auto.scale_once()
+        assert wait_until(
+            lambda: len(
+                [
+                    n
+                    for n in mgr.worker_manager.nodes.values()
+                    if n.status == NodeStatus.RUNNING
+                ]
+            )
+            == 4
+        )
+    finally:
+        mgr.stop()
+
+
+def test_metric_collector_samples_and_completion():
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        perf = PerfMonitor()
+        perf.collect_global_step(5, time.time())
+        reporter = LocalStatsReporter()
+        collector = JobMetricCollector("as-job", mgr, perf, reporter)
+        sample = collector.collect_once()
+        assert sample.worker_count == 2
+        assert sample.global_step == 5
+        assert len(reporter.samples) == 1
+        collector.report_completion(True, "Succeeded", 0)
+        assert reporter.completions[0].success
+    finally:
+        mgr.stop()
+
+
+def test_oom_bump_fires_once():
+    mgr, scaler, cluster = make_managed_cluster(1, memory_mb=1000)
+    try:
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(mgr, perf, cooldown_s=0.0)
+        node = list(mgr.worker_manager.nodes.values())[0]
+        node.exit_reason = NodeExitReason.OOM
+        plan1 = opt.generate_plan()
+        assert not plan1.empty()
+        # Same dead record next round: no compounding bump.
+        plan2 = opt.generate_plan()
+        assert plan2.empty()
+        assert mgr.worker_manager.group_resource.node_resource.memory_mb == (
+            pytest.approx(1500)
+        )
+    finally:
+        mgr.stop()
+
+
+def test_scale_up_moves_rendezvous_window():
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        rdzv = ElasticTrainingRendezvousManager()
+        rdzv.update_rdzv_params(min_nodes=2, max_nodes=2)
+
+        class FixedOptimizer:
+            def generate_plan(self):
+                plan = ResourcePlan(comment="grow")
+                plan.node_group_resources[NodeType.WORKER] = (
+                    NodeGroupResource(count=4)
+                )
+                return plan
+
+        auto = AllreduceTrainingAutoScaler(
+            mgr, scaler, FixedOptimizer(), interval_s=3600,
+            rdzv_managers={"training": rdzv},
+        )
+        auto.scale_once()
+        # A 4-node rendezvous round can now complete.
+        for i in range(4):
+            rdzv.join_rendezvous(i, i, 1)
+        _, _, world = rdzv.get_comm_world(0)
+        assert len(world) == 4
+    finally:
+        mgr.stop()
+
+
+def test_relaunch_uses_bumped_group_resource():
+    mgr, scaler, cluster = make_managed_cluster(1, memory_mb=1000)
+    try:
+        mgr.worker_manager.group_resource.node_resource.memory_mb = 1500
+        victim = list(mgr.worker_manager.nodes.values())[0]
+        cluster.fail_node(victim.id)
+        assert wait_until(
+            lambda: any(
+                n.id != victim.id and n.status == NodeStatus.RUNNING
+                for n in mgr.worker_manager.nodes.values()
+            )
+        )
+        replacement = [
+            n for n in mgr.worker_manager.nodes.values() if n.id != victim.id
+        ][0]
+        assert replacement.config_resource.memory_mb == pytest.approx(1500)
+    finally:
+        mgr.stop()
