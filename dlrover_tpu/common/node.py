@@ -28,6 +28,14 @@ class NodeResource:
     tpu_type: str = ""  # e.g. "v5litepod"
     priority: str = ""
 
+    def is_empty(self) -> bool:
+        return (
+            self.cpu <= 0
+            and self.memory_mb <= 0
+            and self.tpu_chips <= 0
+            and not self.tpu_type
+        )
+
     @classmethod
     def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":
         """Parse "cpu=4,memory=8192Mi,tpu=4" style strings."""

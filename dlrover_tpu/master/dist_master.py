@@ -174,13 +174,14 @@ class DistributedJobMaster:
             try:
                 from dlrover_tpu.master.scaler.pod_scaler import PodScaler
                 from dlrover_tpu.master.watcher.k8s_watcher import PodWatcher
+
+                scaler = PodScaler(args.job_name, args.namespace)
+                watcher = PodWatcher(args.job_name, args.namespace)
             except ImportError as e:
                 raise SystemExit(
                     f"platform {args.platform!r} needs the kubernetes "
-                    f"backend: {e}"
+                    f"python client installed on the master: {e}"
                 )
-            scaler = PodScaler(args.job_name, args.namespace)
-            watcher = PodWatcher(args.job_name, args.namespace)
         else:
             raise ValueError(f"unknown platform {args.platform!r}")
         legal_counts = None
@@ -210,6 +211,13 @@ class DistributedJobMaster:
                 waiting_timeout=30.0,
             )
         self._server.start()
+        # Late-bind the master address into worker env injection: the RPC
+        # port is only known after the server starts.
+        from dlrover_tpu.common.env_utils import get_hostname_ip
+
+        self.job_manager._scaler.set_master_addr(
+            f"{get_hostname_ip()[1]}:{self.port}"
+        )
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()

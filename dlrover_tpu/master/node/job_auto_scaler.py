@@ -68,8 +68,12 @@ class AllreduceTrainingAutoScaler:
             plan.comment,
         )
         # Adopt the (possibly resource-bumped) template so relaunches and
-        # new nodes use it even when the count is unchanged.
-        worker_manager.group_resource.node_resource = group.node_resource
+        # new nodes use it even when the count is unchanged. Count-only
+        # plans carry an empty template and must not wipe the live one.
+        if not group.node_resource.is_empty():
+            worker_manager.group_resource.node_resource = (
+                group.node_resource
+            )
         scale_plan = worker_manager.adjust_worker(group.count)
         if not scale_plan.empty():
             self._scaler.scale(scale_plan)

@@ -173,7 +173,10 @@ class WorkerManager(TrainingNodeManager):
         """Scale the worker group to target_count (reference
         worker.py WorkerManager.adjust_worker)."""
         plan = ScalePlan()
-        alive = self.alive_nodes()
+        # Every non-finished record occupies a rank: INITIAL covers the
+        # window between a relaunch decision and the watcher seeing the
+        # new pod — scaling in that window must not double-assign ranks.
+        alive = [n for n in self.nodes.values() if not n.is_end()]
         delta = target_count - len(alive)
         if delta == 0:
             return plan

@@ -145,21 +145,34 @@ class AllreduceLocalOptimizer(ResourceOptimizer):
         return plan
 
     def _next_count(self, current: int) -> int:
-        if self._legal_counts:
-            candidates = self._legal_counts
-        else:
-            candidates = [current, current * 2]
+        if not self._legal_counts:
+            # Without an explicit legal-shape list there is no safe upper
+            # bound to grow toward (TPU mesh shapes are physical): leave
+            # the count alone; only OOM memory bumps apply.
+            return current
+        candidates = self._legal_counts
+        cur_speed = self._speed_at(current)
+        if cur_speed <= 0:
+            return current  # no evidence yet
+
+        # Retreat first: if we grew here and the measured efficiency vs
+        # the next smaller legal count is poor, step back down.
+        smaller = [c for c in candidates if c < current]
+        if smaller:
+            prev = max(smaller)
+            prev_speed = self._speed_at(prev)
+            if prev_speed > 0:
+                eff = (cur_speed / prev_speed) / (current / prev)
+                if eff < self._min_eff:
+                    return prev
         bigger = [c for c in candidates if c > current]
         if not bigger:
             return current
         target = min(bigger)
-        cur_speed = self._speed_at(current)
-        if cur_speed <= 0:
-            return current  # no evidence yet
         seen_target = self._speed_at(target)
         if seen_target > 0:
-            # We have run at the bigger size before: keep it only if the
-            # marginal efficiency was acceptable.
+            # Already tried the bigger size: grow again only if it was
+            # efficient back then.
             eff = (seen_target / cur_speed) / (target / current)
             if eff < self._min_eff:
                 return current

@@ -60,6 +60,11 @@ class Scaler(abc.ABC):
     def stop(self):
         pass
 
+    def set_master_addr(self, addr: str):
+        """Late-bind the master's RPC address (known only once the server
+        starts) into whatever the backend injects into workers. No-op for
+        backends that don't launch agent processes."""
+
     @abc.abstractmethod
     def scale(self, plan: ScalePlan):
         """Make the backend converge to the plan. Must be idempotent."""

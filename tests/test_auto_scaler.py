@@ -249,3 +249,54 @@ def test_relaunch_uses_bumped_group_resource():
         assert replacement.config_resource.memory_mb == pytest.approx(1500)
     finally:
         mgr.stop()
+
+
+def test_optimizer_scales_down_when_inefficient():
+    from dlrover_tpu.master.resource.optimizer import _SpeedSample
+
+    mgr, scaler, cluster = make_managed_cluster(4)
+    try:
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(
+            mgr, perf, legal_counts=[2, 4], cooldown_s=0.0,
+            min_scaling_efficiency=0.7,
+        )
+        # Grew to 4 but only 1.2x the 2-worker speed: retreat to 2.
+        opt._samples.append(_SpeedSample(2, 1.0, time.time()))
+        opt._samples.append(_SpeedSample(4, 1.2, time.time()))
+        plan = opt.generate_plan()
+        assert plan.node_group_resources[NodeType.WORKER].count == 2
+    finally:
+        mgr.stop()
+
+
+def test_optimizer_holds_without_legal_counts():
+    from dlrover_tpu.master.resource.optimizer import _SpeedSample
+
+    mgr, scaler, cluster = make_managed_cluster(2)
+    try:
+        perf = PerfMonitor()
+        opt = AllreduceLocalOptimizer(mgr, perf, cooldown_s=0.0)
+        opt._samples.append(_SpeedSample(2, 1.0, time.time()))
+        assert opt.generate_plan().empty()
+    finally:
+        mgr.stop()
+
+
+def test_count_only_plan_keeps_resource_template():
+    mgr, scaler, cluster = make_managed_cluster(2, memory_mb=2048)
+    try:
+        auto = AllreduceTrainingAutoScaler(
+            mgr, scaler, optimizer=None, interval_s=3600
+        )
+        plan = ResourcePlan(comment="count-only")
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=3
+        )
+        auto.execute_plan(plan)
+        assert (
+            mgr.worker_manager.group_resource.node_resource.memory_mb
+            == 2048
+        )
+    finally:
+        mgr.stop()

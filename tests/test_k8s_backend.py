@@ -1,0 +1,271 @@
+"""k8s backend tests with a fake API (reference mock_k8s_client pattern,
+tests/test_utils.py:321 — no cluster, no kubernetes package needed)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_context import JobContext
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.master.scaler.elasticjob_scaler import (
+    ElasticJobScaler,
+    scale_plan_crd,
+)
+from dlrover_tpu.master.scaler.pod_scaler import (
+    PodScaler,
+    build_worker_pod_manifest,
+)
+from dlrover_tpu.master.scheduler.k8s_client import K8sApi
+from dlrover_tpu.master.watcher.k8s_watcher import PodWatcher, pod_to_node
+
+
+class FakeK8sApi(K8sApi):
+    """In-memory pod store + watch stream; schedules pods to Running."""
+
+    def __init__(self, auto_run: bool = True):
+        self.pods = {}
+        self.custom_objects = []
+        self.deleted = []
+        self.events: "queue.Queue" = queue.Queue()
+        self.auto_run = auto_run
+        self._lock = threading.Lock()
+
+    def create_pod(self, namespace, pod_manifest):
+        name = pod_manifest["metadata"]["name"]
+        with self._lock:
+            pod_manifest.setdefault("status", {})["phase"] = "Pending"
+            self.pods[name] = pod_manifest
+        self.events.put({"type": "ADDED", "object": pod_manifest})
+        if self.auto_run:
+            self.set_phase(name, "Running")
+        return True
+
+    def delete_pod(self, namespace, name):
+        with self._lock:
+            pod = self.pods.pop(name, None)
+            self.deleted.append(name)
+        if pod is not None:
+            self.events.put({"type": "DELETED", "object": pod})
+        return True
+
+    def list_pods(self, namespace, label_selector):
+        with self._lock:
+            return list(self.pods.values())
+
+    def watch_pods(self, namespace, label_selector):
+        while True:
+            event = self.events.get()
+            if event is None:
+                return
+            yield event
+
+    def create_custom_object(self, namespace, plural, body):
+        self.custom_objects.append((plural, body))
+        return True
+
+    def create_service(self, namespace, manifest):
+        return True
+
+    # ---- test controls -----------------------------------------------------
+
+    def set_phase(self, name, phase, **status_extra):
+        with self._lock:
+            pod = self.pods.get(name)
+            if pod is None:
+                return
+            pod["status"]["phase"] = phase
+            pod["status"].update(status_extra)
+        self.events.put({"type": "MODIFIED", "object": pod})
+
+    def stop_watch(self):
+        self.events.put(None)
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def make_node(node_id=0, rank=0, tpu_chips=4, memory_mb=2048):
+    return Node(
+        NodeType.WORKER,
+        node_id,
+        rank_index=rank,
+        config_resource=NodeResource(
+            tpu_chips=tpu_chips, memory_mb=memory_mb, tpu_type="tpu-v5e"
+        ),
+    )
+
+
+# ---- manifests --------------------------------------------------------------
+
+
+def test_worker_pod_manifest_tpu_shape():
+    node = make_node(3, 1)
+    manifest = build_worker_pod_manifest(
+        "jobx", node, "10.0.0.1:5000", "img:1", tpu_topology="2x4"
+    )
+    limits = manifest["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"
+    assert limits["memory"] == "2048Mi"
+    sel = manifest["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5e"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    env = {
+        e["name"]: e["value"]
+        for e in manifest["spec"]["containers"][0]["env"]
+    }
+    assert env["DLROVER_TPU_NODE_RANK"] == "1"
+    assert env["DLROVER_TPU_MASTER_ADDR"] == "10.0.0.1:5000"
+    labels = manifest["metadata"]["labels"]
+    assert labels["job-name"] == "jobx" and labels["node-id"] == "3"
+
+
+def test_pod_scaler_creates_and_deletes():
+    api = FakeK8sApi()
+    scaler = PodScaler("jobx", master_addr="m:1", api=api)
+    plan = ScalePlan(launch_nodes=[make_node(0), make_node(1, 1)])
+    scaler.scale_now(plan)
+    assert set(api.pods) == {"jobx-worker-0", "jobx-worker-1"}
+    scaler.scale_now(ScalePlan(remove_nodes=[make_node(0)]))
+    assert "jobx-worker-0" in api.deleted
+
+
+def test_elasticjob_scaler_emits_crd():
+    api = FakeK8sApi()
+    scaler = ElasticJobScaler("jobx", api=api)
+    plan = ScalePlan(
+        node_group_resources={
+            NodeType.WORKER: NodeGroupResource(
+                count=4, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        launch_nodes=[make_node(5, 2)],
+    )
+    scaler.scale(plan)
+    plural, body = api.custom_objects[0]
+    assert plural == "scaleplans"
+    spec = body["spec"]
+    assert spec["replicaResourceSpecs"]["worker"]["replicas"] == 4
+    assert spec["createPods"][0]["rankIndex"] == 2
+
+
+def test_scale_plan_crd_remove_pods():
+    plan = ScalePlan(remove_nodes=[make_node(7)])
+    body = scale_plan_crd("jobx", plan, 0)
+    assert body["spec"]["removePods"] == ["jobx-worker-7"]
+
+
+# ---- watcher ----------------------------------------------------------------
+
+
+def test_pod_to_node_phases_and_exit_reasons():
+    manifest = build_worker_pod_manifest(
+        "jobx", make_node(2, 1), "m:1", "img"
+    )
+    manifest["status"] = {"phase": "Running", "podIP": "10.1.2.3"}
+    node = pod_to_node(manifest)
+    assert node.id == 2 and node.rank_index == 1
+    assert node.status == NodeStatus.RUNNING
+    assert node.host_ip == "10.1.2.3"
+
+    manifest["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {"state": {"terminated": {"reason": "OOMKilled", "exitCode": 137}}}
+        ],
+    }
+    node = pod_to_node(manifest)
+    assert node.status == NodeStatus.FAILED
+    assert node.exit_reason == NodeExitReason.OOM
+
+    manifest["status"] = {"phase": "Failed", "reason": "Preempted"}
+    node = pod_to_node(manifest)
+    assert node.exit_reason == NodeExitReason.PREEMPTED
+
+    manifest["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {"state": {"terminated": {"exitCode": 202}}}
+        ],
+    }
+    node = pod_to_node(manifest)
+    assert node.exit_reason == NodeExitReason.HARDWARE_ERROR
+
+    # Foreign pods are ignored.
+    assert pod_to_node({"metadata": {"labels": {"app": "other"}}}) is None
+
+
+# ---- end-to-end over the fake API -------------------------------------------
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_job_manager_over_k8s_backend():
+    api = FakeK8sApi()
+    scaler = PodScaler("jobx", master_addr="m:1", api=api)
+    watcher = PodWatcher("jobx", api=api)
+    mgr = DistributedJobManager(
+        job_name="jobx",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=2, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        scaler=scaler,
+        watcher=watcher,
+    )
+    try:
+        mgr.start()
+
+        def running():
+            return [
+                n
+                for n in mgr.worker_manager.nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+
+        assert wait_until(lambda: len(running()) == 2)
+        # Kill pod 0 with an OOM: the manager relaunches a replacement.
+        api.set_phase(
+            "jobx-worker-0",
+            "Failed",
+            containerStatuses=[
+                {
+                    "state": {
+                        "terminated": {
+                            "reason": "OOMKilled",
+                            "exitCode": 137,
+                        }
+                    }
+                }
+            ],
+        )
+        assert wait_until(
+            lambda: any(
+                n.id not in (0, 1) and n.status == NodeStatus.RUNNING
+                for n in mgr.worker_manager.nodes.values()
+            )
+        )
+        assert "jobx-worker-0" in api.deleted
+    finally:
+        mgr.stop()
+        api.stop_watch()
