@@ -101,6 +101,23 @@ def run_network_check(
     timeout: float = NetworkCheckConstant.CHECK_TIMEOUT,
 ) -> bool:
     """Run up to two probe rounds; returns False if THIS node is faulty."""
+    from dlrover_tpu.training_event import AgentEvents
+
+    span = AgentEvents.node_check().begin()
+    ok = _run_network_check(
+        client, node_rank, nproc_per_node, comm_perf, timeout
+    )
+    span.end(success=ok)
+    return ok
+
+
+def _run_network_check(
+    client: MasterClient,
+    node_rank: int,
+    nproc_per_node: int = 1,
+    comm_perf: bool = False,
+    timeout: float = NetworkCheckConstant.CHECK_TIMEOUT,
+) -> bool:
     for attempt in range(2):
         handler = MasterRendezvousHandler(
             client,

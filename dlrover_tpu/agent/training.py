@@ -119,8 +119,11 @@ class ElasticAgent:
     # ---- worker lifecycle --------------------------------------------------
 
     def _initialize_workers(self) -> RendezvousOutcome:
+        from dlrover_tpu.training_event import AgentEvents
+
         rdzv_start = time.time()
-        outcome = self._rdzv.next_rendezvous()
+        with AgentEvents.rendezvous({"node_rank": self._spec.node_rank}):
+            outcome = self._rdzv.next_rendezvous()
         self._client.report_goodput_phase(
             GoodputPhase.RENDEZVOUS, rdzv_start, time.time()
         )
@@ -131,7 +134,12 @@ class ElasticAgent:
         return outcome
 
     def _start_workers(self, outcome: RendezvousOutcome):
+        from dlrover_tpu.training_event import AgentEvents
+
         spec = self._spec
+        self._start_span = AgentEvents.start_workers(
+            self._restart_count
+        ).begin()
         self._workers = []
         # Workers must be able to import this framework even when the
         # launcher was started from a different cwd/PYTHONPATH.
@@ -190,6 +198,7 @@ class ElasticAgent:
                 proc.pid,
                 outcome.process_id_base + local_rank,
             )
+        self._start_span.end(num_workers=len(self._workers))
 
     def _stop_workers(self, timeout: float = 15.0):
         for w in self._workers:
@@ -290,6 +299,9 @@ class ElasticAgent:
                 max_restarts=self._spec.max_restarts,
             )
         )
+        from dlrover_tpu.training_event import AgentEvents
+
+        AgentEvents.worker_failure(codes, decision)
         try:
             self._client.report_failure(
                 error_data=str(codes),

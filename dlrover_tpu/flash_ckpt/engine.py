@@ -93,6 +93,9 @@ class CheckpointEngine:
         """Blocking-path save: device -> shm. Returns block seconds."""
         import jax
 
+        from dlrover_tpu.training_event import TrainerEvents
+
+        span = TrainerEvents.ckpt_save_memory(step).begin()
         start = time.time()
         jax.block_until_ready(state)
         meta = dict(user_meta or {})
@@ -117,6 +120,7 @@ class CheckpointEngine:
             )
         elapsed = time.time() - start
         self._last_save_time = time.time()
+        span.end(block_s=elapsed)
         logger.info(
             "flash ckpt step %d -> shm in %.3fs", step, elapsed
         )
@@ -164,13 +168,17 @@ class CheckpointEngine:
         Memory-first: the shm image survives worker restarts on the same
         host. Falls back to the committed storage checkpoint.
         """
+        from dlrover_tpu.training_event import TrainerEvents
+
         result = self._load_from_memory(step)
         if result is not None:
             logger.info("restored step %d from host memory", result[0])
+            TrainerEvents.ckpt_restore(result[0], "memory")
             return result
         result = self._load_from_storage(step)
         if result is not None:
             logger.info("restored step %d from storage", result[0])
+            TrainerEvents.ckpt_restore(result[0], "storage")
         return result
 
     def _load_from_memory(self, step: Optional[int] = None):

@@ -247,16 +247,20 @@ class AsyncCheckpointSaver:
             self._push_replicas(event)
             return
         if event.kind == SaveEvent.SAVE_DISK:
+            from dlrover_tpu.training_event import TrainerEvents
+
             self._latest_mem_event = event
-            ok = persist_shm_to_storage(
-                event.checkpoint_dir,
-                event.step,
-                self._node_rank,
-                event.local_world_size,
-                self._world_nodes,
-                master_client=self._client,
-                locks=self._locks,
-            )
+            with TrainerEvents.ckpt_persist(event.step) as span:
+                ok = persist_shm_to_storage(
+                    event.checkpoint_dir,
+                    event.step,
+                    self._node_rank,
+                    event.local_world_size,
+                    self._world_nodes,
+                    master_client=self._client,
+                    locks=self._locks,
+                )
+                span.content["committed"] = ok
             if ok:
                 self._last_persisted_step = event.step
             self._push_replicas(event)

@@ -29,6 +29,12 @@ def build_master_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pre_check", action="store_true", default=False)
     parser.add_argument("--network_check", action="store_true", default=False)
     parser.add_argument(
+        "--dashboard_port",
+        type=int,
+        default=-1,
+        help="serve the web dashboard on this port (-1 = off, 0 = auto)",
+    )
+    parser.add_argument(
         "--auto_scale",
         action="store_true",
         default=False,

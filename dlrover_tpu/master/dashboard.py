@@ -1,0 +1,122 @@
+"""Master web dashboard: job/node state over HTTP.
+
+Parity: reference dlrover/dashboard (tornado app wired at
+master/main.py:100-107) — rebuilt on the stdlib HTTP server: JSON APIs
+(/api/job, /api/perf) plus a single self-contained HTML page rendering
+the node table and training progress.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>dlrover-tpu</title>
+<style>
+body{font-family:monospace;margin:2em;background:#fafafa}
+table{border-collapse:collapse}td,th{border:1px solid #999;padding:4px 10px}
+h1{font-size:1.3em}.Running{color:green}.Failed,.Breakdown{color:red}
+.Pending,.Initial{color:#b8860b}.Succeeded{color:blue}
+</style></head><body>
+<h1>dlrover-tpu job <span id="job"></span></h1>
+<p>stage: <b id="stage"></b> | step: <b id="step"></b> |
+speed: <b id="speed"></b> steps/s | goodput: <b id="goodput"></b>%</p>
+<table id="nodes"><tr><th>id</th><th>rank</th><th>status</th>
+<th>relaunches</th><th>host</th></tr></table>
+<script>
+async function refresh(){
+ const job = await (await fetch('/api/job')).json();
+ const perf = await (await fetch('/api/perf')).json();
+ document.getElementById('job').textContent = job.job_name;
+ document.getElementById('stage').textContent = job.stage;
+ document.getElementById('step').textContent = perf.global_step;
+ document.getElementById('speed').textContent = perf.speed.toFixed(2);
+ document.getElementById('goodput').textContent = (perf.goodput*100).toFixed(1);
+ const t = document.getElementById('nodes');
+ while(t.rows.length > 1) t.deleteRow(1);
+ for(const [id, n] of Object.entries(job.nodes)){
+  const r = t.insertRow();
+  r.insertCell().textContent = id;
+  r.insertCell().textContent = n.rank;
+  const c = r.insertCell(); c.textContent = n.status;
+  c.className = n.status;
+  r.insertCell().textContent = n.relaunch_count;
+  r.insertCell().textContent = n.host || '';
+ }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+class DashboardServer:
+    def __init__(self, job_manager, perf_monitor, port: int = 0):
+        self._job_manager = job_manager
+        self._perf_monitor = perf_monitor
+        handler = self._make_handler()
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/" or self.path.startswith("/index"):
+                    self._send(200, _PAGE, "text/html")
+                elif self.path == "/api/job":
+                    detail = dashboard._job_detail()
+                    self._send(200, json.dumps(detail), "application/json")
+                elif self.path == "/api/perf":
+                    self._send(
+                        200,
+                        json.dumps(dashboard._perf()),
+                        "application/json",
+                    )
+                else:
+                    self._send(404, "not found", "text/plain")
+
+            def _send(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        return Handler
+
+    def _job_detail(self):
+        detail = self._job_manager.get_job_detail()
+        return {
+            "job_name": detail.job_name,
+            "stage": detail.stage,
+            "nodes": detail.nodes,
+        }
+
+    def _perf(self):
+        return {
+            "global_step": self._perf_monitor.global_step,
+            "speed": self._perf_monitor.running_speed(),
+            "goodput": self._perf_monitor.goodput(),
+        }
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="dashboard",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("dashboard on port %d", self.port)
+
+    def stop(self):
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()

@@ -52,6 +52,7 @@ class DistributedJobMaster:
         pre_check: bool = False,
         auto_scale: bool = False,
         legal_worker_counts=None,
+        dashboard_port: int = -1,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -100,6 +101,13 @@ class DistributedJobMaster:
         self.metric_collector = JobMetricCollector(
             job_name, self.job_manager, self.perf_monitor
         )
+        self.dashboard = None
+        if dashboard_port >= 0:
+            from dlrover_tpu.master.dashboard import DashboardServer
+
+            self.dashboard = DashboardServer(
+                self.job_manager, self.perf_monitor, dashboard_port
+            )
         self.auto_scaler = None
         if auto_scale:
             from dlrover_tpu.master.node.job_auto_scaler import (
@@ -199,6 +207,7 @@ class DistributedJobMaster:
             pre_check=getattr(args, "pre_check", False),
             auto_scale=getattr(args, "auto_scale", False),
             legal_worker_counts=legal_counts,
+            dashboard_port=getattr(args, "dashboard_port", -1),
         )
 
     # ---- lifecycle ---------------------------------------------------------
@@ -215,12 +224,14 @@ class DistributedJobMaster:
         # port is only known after the server starts.
         from dlrover_tpu.common.env_utils import get_hostname_ip
 
-        self.job_manager._scaler.set_master_addr(
+        self.job_manager.set_master_addr(
             f"{get_hostname_ip()[1]}:{self.port}"
         )
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()
+        if self.dashboard is not None:
+            self.dashboard.start()
         if self.auto_scaler is not None:
             self.auto_scaler.start()
         if self.diagnosis_master is not None:
@@ -273,6 +284,9 @@ class DistributedJobMaster:
             action = self._job_context.next_master_action()
             if action is None:
                 continue
+            from dlrover_tpu.training_event import MasterEvents
+
+            MasterEvents.diagnosis_action(action.action_type, action.reason)
             if action.action_type == DiagnosisActionType.JOB_RESTART:
                 logger.warning("diagnosis: restarting workers (%s)",
                                action.reason)
@@ -290,6 +304,8 @@ class DistributedJobMaster:
             failure_count=self._job_context.failure_count,
         )
         self.metric_collector.stop()
+        if self.dashboard is not None:
+            self.dashboard.stop()
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
         if self.diagnosis_master is not None:

@@ -208,6 +208,11 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                     self._rdzv_round - 1,
                     self._latest_world,
                 )
+                from dlrover_tpu.training_event import MasterEvents
+
+                MasterEvents.rdzv_round(
+                    self.name, self._rdzv_round - 1, len(self._latest_world)
+                )
             if node_rank in self._latest_world:
                 return self._rdzv_round - 1, 0, dict(self._latest_world)
             return self._rdzv_round, 0, {}

@@ -35,6 +35,7 @@ from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.node.training_node import WorkerManager
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
 from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
+from dlrover_tpu.training_event import MasterEvents
 
 _MONITOR_INTERVAL_S = 1.0
 
@@ -88,6 +89,9 @@ class DistributedJobManager:
     @property
     def worker_manager(self) -> WorkerManager:
         return self._worker_manager
+
+    def set_master_addr(self, addr: str):
+        self._scaler.set_master_addr(addr)
 
     def _new_node_id(self) -> int:
         with self._id_lock:
@@ -237,6 +241,9 @@ class DistributedJobManager:
             new_status,
             node.exit_reason or event.event_type,
         )
+        MasterEvents.node_status(
+            node.id, new_status, node.exit_reason or event.event_type
+        )
 
         if new_status == NodeStatus.RUNNING:
             for cb in self._node_event_callbacks:
@@ -270,6 +277,9 @@ class DistributedJobManager:
                     node.max_relaunch_count,
                 )
                 self._job_context.update_node(new_node)
+                MasterEvents.node_relaunch(
+                    node.id, node.rank_index, node.exit_reason
+                )
                 self._scaler.scale(plan)
                 return
         logger.warning("node %s will not be relaunched", node.name)

@@ -67,6 +67,9 @@ class AllreduceTrainingAutoScaler:
             group.count,
             plan.comment,
         )
+        from dlrover_tpu.training_event import MasterEvents
+
+        MasterEvents.scale_plan(plan.comment, group.count)
         # Adopt the (possibly resource-bumped) template so relaunches and
         # new nodes use it even when the count is unchanged. Count-only
         # plans carry an empty template and must not wipe the live one.

@@ -75,7 +75,12 @@ class JobContext:
 
     def set_job_stage(self, stage: str):
         with self._lock:
+            changed = stage != self._job_stage
             self._job_stage = stage
+        if changed:
+            from dlrover_tpu.training_event import MasterEvents
+
+            MasterEvents.job_stage(stage)
 
     # ---- diagnosis actions -------------------------------------------------
 

@@ -8,6 +8,7 @@ when pod creation requires cluster-level privileges the master lacks.
 """
 
 import itertools
+import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
@@ -21,7 +22,9 @@ from dlrover_tpu.master.scheduler.k8s_client import (
 )
 
 
-def scale_plan_crd(job_name: str, plan: ScalePlan, index: int) -> Dict:
+def scale_plan_crd(
+    job_name: str, plan: ScalePlan, index, epoch: str = ""
+) -> Dict:
     group_specs = {}
     for role, group in plan.node_group_resources.items():
         group_specs[role] = {
@@ -37,7 +40,10 @@ def scale_plan_crd(job_name: str, plan: ScalePlan, index: int) -> Dict:
         "apiVersion": f"{ELASTICJOB_GROUP}/{ELASTICJOB_VERSION}",
         "kind": "ScalePlan",
         "metadata": {
-            "name": f"{job_name}-scaleplan-{index}",
+            # The epoch token keeps names unique across master restarts:
+            # a fresh master's counter restarts at 0 and a bare index
+            # would collide with CRs from the previous incarnation.
+            "name": f"{job_name}-scaleplan-{epoch}{index}",
             "labels": {"job-name": job_name},
         },
         "spec": {
@@ -70,11 +76,14 @@ class ElasticJobScaler(Scaler):
         self._namespace = namespace
         self._api = api or get_k8s_api()
         self._index = itertools.count(0)
+        self._epoch = f"{int(time.time())}-"
 
     def scale(self, plan: ScalePlan):
         if plan.empty():
             return
-        body = scale_plan_crd(self._job_name, plan, next(self._index))
+        body = scale_plan_crd(
+            self._job_name, plan, next(self._index), self._epoch
+        )
         if not self._api.create_custom_object(
             self._namespace, SCALEPLAN_PLURAL, body
         ):

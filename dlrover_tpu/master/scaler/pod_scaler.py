@@ -107,6 +107,9 @@ class PodScaler(Scaler):
         self._api = api or get_k8s_api()
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
+        self._create_attempts: Dict[int, int] = {}
+        self._max_create_attempts = 5
+        self._retry_delay_s = 5.0
 
     def set_master_addr(self, addr: str):
         if not self._master_addr:
@@ -143,6 +146,7 @@ class PodScaler(Scaler):
                 logger.exception("scale plan application failed")
 
     def _apply(self, plan: ScalePlan):
+        retry = ScalePlan()
         for node in plan.launch_nodes:
             manifest = build_worker_pod_manifest(
                 self._job_name,
@@ -152,9 +156,31 @@ class PodScaler(Scaler):
                 self._command,
                 self._tpu_topology,
             )
-            if not self._api.create_pod(self._namespace, manifest):
-                logger.error("failed to create pod for %s", node.name)
+            if self._api.create_pod(self._namespace, manifest):
+                self._create_attempts.pop(node.id, None)
+                continue
+            # The scale() contract is convergence: a transient API-server
+            # failure must not permanently orphan the rank.
+            attempts = self._create_attempts.get(node.id, 0) + 1
+            self._create_attempts[node.id] = attempts
+            if attempts < self._max_create_attempts:
+                logger.warning(
+                    "pod create for %s failed (attempt %d); will retry",
+                    node.name,
+                    attempts,
+                )
+                retry.launch_nodes.append(node)
+            else:
+                logger.error(
+                    "pod create for %s failed %d times; giving up",
+                    node.name,
+                    attempts,
+                )
         for node in plan.remove_nodes:
             self._api.delete_pod(
                 self._namespace, pod_name(self._job_name, node)
             )
+        if retry.launch_nodes:
+            threading.Timer(
+                self._retry_delay_s, self._queue.put, args=(retry,)
+            ).start()

@@ -1,0 +1,11 @@
+from dlrover_tpu.training_event.emitter import (  # noqa: F401
+    DurationSpan,
+    Event,
+    EventEmitter,
+    get_emitter,
+)
+from dlrover_tpu.training_event.predefined import (  # noqa: F401
+    AgentEvents,
+    MasterEvents,
+    TrainerEvents,
+)

@@ -1,0 +1,106 @@
+"""Event exporters: where structured events go.
+
+Parity: reference dlrover/python/training_event/exporter.py — an async
+file exporter (JSON lines, one file per process per day) and a console
+exporter, selected by env:
+
+- DLROVER_TPU_EVENT_EXPORTER = file|console|off   (default: file)
+- DLROVER_TPU_EVENT_DIR      = directory for event files
+                               (default: /tmp/dlrover_tpu_events)
+"""
+
+import abc
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+
+class EventExporter(abc.ABC):
+    @abc.abstractmethod
+    def export(self, event):
+        ...
+
+    def close(self):
+        pass
+
+
+class ConsoleExporter(EventExporter):
+    def export(self, event):
+        logger.info("[event] %s", event.to_json())
+
+
+class NullExporter(EventExporter):
+    def export(self, event):
+        pass
+
+
+class AsyncFileExporter(EventExporter):
+    """JSON-lines file writer on a daemon thread; drops events rather
+    than ever blocking the training/control path."""
+
+    def __init__(self, directory: str, max_queue: int = 4096):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._file = None
+        self._file_day = ""
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="event-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def export(self, event):
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            pass
+
+    def close(self):
+        self._stopped.set()
+        self._thread.join(timeout=2)
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def _ensure_file(self):
+        day = time.strftime("%Y%m%d")
+        if self._file is None or day != self._file_day:
+            if self._file:
+                self._file.close()
+            path = os.path.join(
+                self._dir, f"events_{day}_{os.getpid()}.jsonl"
+            )
+            self._file = open(path, "a", buffering=1)
+            self._file_day = day
+
+    def _loop(self):
+        while not self._stopped.is_set() or not self._queue.empty():
+            try:
+                event = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self._ensure_file()
+                self._file.write(event.to_json() + "\n")
+            except Exception:
+                pass
+
+
+def build_default_exporter() -> EventExporter:
+    kind = os.getenv("DLROVER_TPU_EVENT_EXPORTER", "file").lower()
+    if kind == "off":
+        return NullExporter()
+    if kind == "console":
+        return ConsoleExporter()
+    directory = os.getenv(
+        "DLROVER_TPU_EVENT_DIR", "/tmp/dlrover_tpu_events"
+    )
+    try:
+        return AsyncFileExporter(directory)
+    except OSError:
+        return ConsoleExporter()

@@ -1,0 +1,136 @@
+"""training_event SDK + dashboard tests."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node, NodeGroupResource
+from dlrover_tpu.master.dashboard import DashboardServer
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.training_event.emitter import (
+    Event,
+    EventEmitter,
+    EventType,
+)
+from dlrover_tpu.training_event.exporter import (
+    AsyncFileExporter,
+    EventExporter,
+)
+
+
+class ListExporter(EventExporter):
+    def __init__(self):
+        self.events = []
+
+    def export(self, event):
+        self.events.append(event)
+
+
+def test_instant_and_duration_events():
+    exp = ListExporter()
+    emitter = EventEmitter("test", exp)
+    emitter.instant("hello", {"k": 1})
+    with emitter.duration("work", {"j": 2}):
+        pass
+    assert [e.event_type for e in exp.events] == [
+        EventType.INSTANT,
+        EventType.BEGIN,
+        EventType.END,
+    ]
+    begin, end = exp.events[1], exp.events[2]
+    assert begin.event_id == end.event_id
+    assert end.content["success"] is True
+    assert "duration_s" in end.content
+
+
+def test_duration_span_failure():
+    exp = ListExporter()
+    emitter = EventEmitter("test", exp)
+    with pytest.raises(ValueError):
+        with emitter.duration("boom"):
+            raise ValueError("bad")
+    end = exp.events[-1]
+    assert end.content["success"] is False
+    assert "bad" in end.content["error"]
+
+
+def test_event_json_roundtrip():
+    e = Event(name="n", target="t", content={"a": 1})
+    parsed = json.loads(e.to_json())
+    assert parsed["name"] == "n" and parsed["content"] == {"a": 1}
+
+
+def test_async_file_exporter(tmp_path):
+    exp = AsyncFileExporter(str(tmp_path))
+    emitter = EventEmitter("filetest", exp)
+    for i in range(5):
+        emitter.instant("tick", {"i": i})
+    exp.close()
+    files = list(tmp_path.glob("events_*.jsonl"))
+    assert files
+    lines = files[0].read_text().strip().splitlines()
+    assert len(lines) == 5
+    assert json.loads(lines[0])["name"] == "tick"
+
+
+def test_exporter_failure_never_raises():
+    class Broken(EventExporter):
+        def export(self, event):
+            raise RuntimeError("exporter down")
+
+    emitter = EventEmitter("x", Broken())
+    emitter.instant("safe")  # must not raise
+
+
+# ---- dashboard --------------------------------------------------------------
+
+
+class _FakeDetail:
+    job_name = "dash-job"
+    stage = "RUNNING"
+    nodes = {
+        0: {
+            "type": NodeType.WORKER,
+            "rank": 0,
+            "status": NodeStatus.RUNNING,
+            "relaunch_count": 1,
+            "host": "host-a",
+        }
+    }
+
+
+class _FakeJobManager:
+    def get_job_detail(self):
+        return _FakeDetail()
+
+
+def test_dashboard_serves_page_and_apis():
+    perf = PerfMonitor()
+    perf.collect_global_step(42, time.time())
+    dash = DashboardServer(_FakeJobManager(), perf, port=0)
+    dash.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=5)
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert b"dlrover-tpu" in resp.read()
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=5)
+        conn.request("GET", "/api/job")
+        job = json.loads(conn.getresponse().read())
+        assert job["job_name"] == "dash-job"
+        assert job["nodes"]["0"]["status"] == "Running"
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=5)
+        conn.request("GET", "/api/perf")
+        perf_data = json.loads(conn.getresponse().read())
+        assert perf_data["global_step"] == 42
+        conn.close()
+    finally:
+        dash.stop()
