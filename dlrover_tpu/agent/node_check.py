@@ -104,9 +104,13 @@ def run_network_check(
     from dlrover_tpu.training_event import AgentEvents
 
     span = AgentEvents.node_check().begin()
-    ok = _run_network_check(
-        client, node_rank, nproc_per_node, comm_perf, timeout
-    )
+    try:
+        ok = _run_network_check(
+            client, node_rank, nproc_per_node, comm_perf, timeout
+        )
+    except Exception as e:
+        span.fail(str(e))
+        raise
     span.end(success=ok)
     return ok
 

@@ -137,9 +137,11 @@ class ElasticAgent:
         from dlrover_tpu.training_event import AgentEvents
 
         spec = self._spec
-        self._start_span = AgentEvents.start_workers(
-            self._restart_count
-        ).begin()
+        with AgentEvents.start_workers(self._restart_count) as span:
+            self._start_workers_inner(outcome, spec)
+            span.content["num_workers"] = len(self._workers)
+
+    def _start_workers_inner(self, outcome: RendezvousOutcome, spec):
         self._workers = []
         # Workers must be able to import this framework even when the
         # launcher was started from a different cwd/PYTHONPATH.
@@ -198,7 +200,6 @@ class ElasticAgent:
                 proc.pid,
                 outcome.process_id_base + local_rank,
             )
-        self._start_span.end(num_workers=len(self._workers))
 
     def _stop_workers(self, timeout: float = 15.0):
         for w in self._workers:

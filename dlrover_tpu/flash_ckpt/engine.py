@@ -95,32 +95,32 @@ class CheckpointEngine:
 
         from dlrover_tpu.training_event import TrainerEvents
 
-        span = TrainerEvents.ckpt_save_memory(step).begin()
         start = time.time()
-        jax.block_until_ready(state)
-        meta = dict(user_meta or {})
-        meta["process_id"] = self._ctx.process_id
-        meta["num_processes"] = self._ctx.num_processes
-        meta["local_rank"] = self._local_rank
-        if self._lock is not None:
-            self._lock.acquire()
-        try:
-            self._shm.save_state_dict(step, state, meta)
-        finally:
+        with TrainerEvents.ckpt_save_memory(step) as span:
+            jax.block_until_ready(state)
+            meta = dict(user_meta or {})
+            meta["process_id"] = self._ctx.process_id
+            meta["num_processes"] = self._ctx.num_processes
+            meta["local_rank"] = self._local_rank
             if self._lock is not None:
-                self._lock.release()
-        if self._event_queue is not None and self._local_rank == 0:
-            self._event_queue.put(
-                SaveEvent(
-                    SaveEvent.SAVE_MEM,
-                    step,
-                    self.checkpoint_dir,
-                    self._ctx.local_world_size,
+                self._lock.acquire()
+            try:
+                self._shm.save_state_dict(step, state, meta)
+            finally:
+                if self._lock is not None:
+                    self._lock.release()
+            if self._event_queue is not None and self._local_rank == 0:
+                self._event_queue.put(
+                    SaveEvent(
+                        SaveEvent.SAVE_MEM,
+                        step,
+                        self.checkpoint_dir,
+                        self._ctx.local_world_size,
+                    )
                 )
-            )
-        elapsed = time.time() - start
+            elapsed = time.time() - start
+            span.content["block_s"] = elapsed
         self._last_save_time = time.time()
-        span.end(block_s=elapsed)
         logger.info(
             "flash ckpt step %d -> shm in %.3fs", step, elapsed
         )

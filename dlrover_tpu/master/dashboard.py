@@ -55,9 +55,9 @@ class DashboardServer:
     def __init__(self, job_manager, perf_monitor, port: int = 0):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
-        handler = self._make_handler()
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), handler)
-        self.port = self._server.server_address[1]
+        self._requested_port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.port = 0
         self._thread: Optional[threading.Thread] = None
 
     def _make_handler(self):
@@ -108,6 +108,20 @@ class DashboardServer:
         }
 
     def start(self):
+        # Bind lazily and degrade gracefully: a taken port must not take
+        # down the master for a monitoring-only feature.
+        try:
+            self._server = ThreadingHTTPServer(
+                ("0.0.0.0", self._requested_port), self._make_handler()
+            )
+        except OSError as e:
+            logger.error(
+                "dashboard disabled: cannot bind port %d (%s)",
+                self._requested_port,
+                e,
+            )
+            return
+        self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name="dashboard",
@@ -117,6 +131,8 @@ class DashboardServer:
         logger.info("dashboard on port %d", self.port)
 
     def stop(self):
+        if self._server is None:
+            return
         if self._thread is not None:
             self._server.shutdown()
         self._server.server_close()

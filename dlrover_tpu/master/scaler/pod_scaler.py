@@ -110,6 +110,11 @@ class PodScaler(Scaler):
         self._create_attempts: Dict[int, int] = {}
         self._max_create_attempts = 5
         self._retry_delay_s = 5.0
+        # Node ids removed since their (possibly failed) create: a retry
+        # must not resurrect a pod that was scaled away in the meantime.
+        self._removed_ids: set = set()
+        self._retry_timers: list = []
+        self._stopped = False
 
     def set_master_addr(self, addr: str):
         if not self._master_addr:
@@ -123,6 +128,9 @@ class PodScaler(Scaler):
             self._thread.start()
 
     def stop(self):
+        self._stopped = True
+        for timer in self._retry_timers:
+            timer.cancel()
         self._queue.put(_QUEUE_STOP)
 
     def scale(self, plan: ScalePlan):
@@ -148,6 +156,8 @@ class PodScaler(Scaler):
     def _apply(self, plan: ScalePlan):
         retry = ScalePlan()
         for node in plan.launch_nodes:
+            if node.id in self._removed_ids:
+                continue  # scaled away while a retry was pending
             manifest = build_worker_pod_manifest(
                 self._job_name,
                 node,
@@ -177,10 +187,16 @@ class PodScaler(Scaler):
                     attempts,
                 )
         for node in plan.remove_nodes:
+            self._removed_ids.add(node.id)
             self._api.delete_pod(
                 self._namespace, pod_name(self._job_name, node)
             )
-        if retry.launch_nodes:
-            threading.Timer(
+        if retry.launch_nodes and not self._stopped:
+            timer = threading.Timer(
                 self._retry_delay_s, self._queue.put, args=(retry,)
-            ).start()
+            )
+            timer.daemon = True
+            timer.start()
+            self._retry_timers = [
+                t for t in self._retry_timers if t.is_alive()
+            ] + [timer]

@@ -73,7 +73,9 @@ class DurationSpan:
                 event_type=EventType.BEGIN,
                 target=self._emitter.target,
                 event_id=self.event_id,
-                content=self.content,
+                # Copy: callers may mutate span.content before end(),
+                # and the async exporter serializes on another thread.
+                content=dict(self.content),
             )
         )
         return self

@@ -1,0 +1,3 @@
+from dlrover_tpu.unified.builder import DLJobBuilder  # noqa: F401
+from dlrover_tpu.unified.config import DLJobConfig, RoleConfig  # noqa: F401
+from dlrover_tpu.unified.master import PrimeMaster, submit  # noqa: F401

@@ -1,0 +1,132 @@
+"""Worker backends for the unified layer.
+
+Parity: reference dlrover/python/unified/backend (ElasticWorker /
+BaseWorker Ray actors). Ray is not a baked-in dependency, so the
+first-class backend runs each vertex as a local subprocess with role
+coordinates injected via env — the same contract a Ray-actor backend
+implements when ``ray`` is importable (gated in RayBackend.available()).
+"""
+
+import abc
+import os
+import signal
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.config import RoleConfig
+from dlrover_tpu.unified.graph import Vertex
+
+
+class UnifiedEnv:
+    ROLE = "DLROVER_TPU_ROLE"
+    ROLE_RANK = "DLROVER_TPU_ROLE_RANK"
+    ROLE_WORLD_SIZE = "DLROVER_TPU_ROLE_WORLD_SIZE"
+    GROUP_INDEX = "DLROVER_TPU_GROUP_INDEX"
+    BUNDLE_ID = "DLROVER_TPU_BUNDLE_ID"
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+
+
+@dataclass
+class WorkerHandle:
+    vertex: Vertex
+    process: subprocess.Popen
+
+
+class Backend(abc.ABC):
+    @abc.abstractmethod
+    def start_worker(
+        self, vertex: Vertex, role: RoleConfig, job_name: str
+    ) -> WorkerHandle:
+        ...
+
+    @abc.abstractmethod
+    def poll(self, handle: WorkerHandle) -> Optional[int]:
+        """None while running, else the exit code."""
+
+    @abc.abstractmethod
+    def stop_worker(self, handle: WorkerHandle, timeout: float = 10.0):
+        ...
+
+
+class LocalProcessBackend(Backend):
+    def start_worker(
+        self, vertex: Vertex, role: RoleConfig, job_name: str
+    ) -> WorkerHandle:
+        env = dict(os.environ)
+        env.update(vertex.envs)
+        env.update(
+            {
+                UnifiedEnv.ROLE: vertex.role,
+                UnifiedEnv.ROLE_RANK: str(vertex.rank),
+                UnifiedEnv.ROLE_WORLD_SIZE: str(vertex.world_size),
+                UnifiedEnv.GROUP_INDEX: str(vertex.group_index),
+                UnifiedEnv.BUNDLE_ID: str(vertex.bundle_id),
+                UnifiedEnv.JOB_NAME: job_name,
+            }
+        )
+        if ":" in role.entrypoint:
+            module, fn = role.entrypoint.split(":", 1)
+            code = f"import {module}; {module}.{fn}()"
+            cmd = [sys.executable, "-c", code]
+        else:
+            cmd = [sys.executable, "-m", role.entrypoint]
+        cmd += role.args
+        proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+        logger.info(
+            "started %s pid=%d (%s)", vertex.name, proc.pid, role.entrypoint
+        )
+        return WorkerHandle(vertex=vertex, process=proc)
+
+    def poll(self, handle: WorkerHandle) -> Optional[int]:
+        return handle.process.poll()
+
+    def stop_worker(self, handle: WorkerHandle, timeout: float = 10.0):
+        if handle.process.poll() is not None:
+            return
+        try:
+            os.killpg(handle.process.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        try:
+            handle.process.wait(timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(handle.process.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            handle.process.wait()
+
+
+class RayBackend(Backend):
+    """Ray-actor backend; only constructible when ray is installed."""
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            import ray  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+    def __init__(self):
+        if not self.available():
+            raise ImportError(
+                "ray is not installed; use LocalProcessBackend"
+            )
+        raise NotImplementedError(
+            "RayBackend is a deployment-time extension point; the "
+            "process contract matches LocalProcessBackend"
+        )
+
+    def start_worker(self, vertex, role, job_name):
+        raise NotImplementedError
+
+    def poll(self, handle):
+        raise NotImplementedError
+
+    def stop_worker(self, handle, timeout=10.0):
+        raise NotImplementedError

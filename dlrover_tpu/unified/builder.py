@@ -1,0 +1,108 @@
+"""Fluent DLJobBuilder DSL.
+
+Parity: reference dlrover/python/unified/api/builder/base.py:154-631
+(DLJobBuilder: .train()/.role()/.with_collocation()/.nnodes()...). The
+builder accumulates role specs and produces a validated DLJobConfig.
+
+Example::
+
+    job = (
+        DLJobBuilder("ppo")
+        .nnodes(2)
+        .role("trainer").run("my.train").total(4).per_group(2).add()
+        .role("rollout").run("my.rollout").total(4).add()
+        .with_collocation("trainer", "rollout")
+        .build()
+    )
+"""
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.unified.config import DLJobConfig, RoleConfig
+
+
+class RoleBuilder:
+    def __init__(self, parent: "DLJobBuilder", name: str):
+        self._parent = parent
+        self._role = RoleConfig(name=name, entrypoint="")
+
+    def run(self, entrypoint: str) -> "RoleBuilder":
+        self._role.entrypoint = entrypoint
+        return self
+
+    def total(self, n: int) -> "RoleBuilder":
+        self._role.total = n
+        return self
+
+    def per_group(self, n: int) -> "RoleBuilder":
+        self._role.per_group = n
+        return self
+
+    def env(self, key: str, value: str) -> "RoleBuilder":
+        self._role.envs[key] = value
+        return self
+
+    def args(self, *args: str) -> "RoleBuilder":
+        self._role.args = list(args)
+        return self
+
+    def resource(self, **kwargs: float) -> "RoleBuilder":
+        self._role.resource.update(kwargs)
+        return self
+
+    def failover(self, level: str) -> "RoleBuilder":
+        self._role.failover_level = level
+        return self
+
+    def max_restarts(self, n: int) -> "RoleBuilder":
+        self._role.max_restarts = n
+        return self
+
+    def add(self) -> "DLJobBuilder":
+        self._parent._roles.append(self._role)
+        return self._parent
+
+
+class DLJobBuilder:
+    def __init__(self, job_name: str = "unified-job"):
+        self._job_name = job_name
+        self._roles: List[RoleConfig] = []
+        self._collocations: List[List[str]] = []
+        self._node_num = 1
+        self._global_envs: Dict[str, str] = {}
+        self._state_path = ""
+
+    def nnodes(self, n: int) -> "DLJobBuilder":
+        self._node_num = n
+        return self
+
+    def role(self, name: str) -> RoleBuilder:
+        return RoleBuilder(self, name)
+
+    def train(self, entrypoint: str) -> RoleBuilder:
+        """Shorthand: the conventional 'trainer' role."""
+        return self.role("trainer").run(entrypoint)
+
+    def with_collocation(self, *role_names: str) -> "DLJobBuilder":
+        self._collocations.append(list(role_names))
+        return self
+
+    def global_env(self, key: str, value: str) -> "DLJobBuilder":
+        self._global_envs[key] = value
+        return self
+
+    def master_state(self, path: str) -> "DLJobBuilder":
+        self._state_path = path
+        return self
+
+    def build(self) -> DLJobConfig:
+        config = DLJobConfig(
+            job_name=self._job_name,
+            roles=list(self._roles),
+            collocations=list(self._collocations),
+            node_num=self._node_num,
+            global_envs=dict(self._global_envs),
+            master_state_path=self._state_path,
+        )
+        config.validate()
+        return config

@@ -1,0 +1,79 @@
+"""Unified job configuration model.
+
+Parity: reference dlrover/python/unified/common (pydantic DLConfig /
+WorkloadDesc, workload_desc.py) — plain validated dataclasses instead of
+pydantic: the surface is small and dependency-light.
+
+A job is a set of ROLES (trainer, actor, rollout, reward, ...); each
+role runs ``total`` processes grouped ``per_group`` per node-slot, with
+a python entrypoint (module or function path) and resource needs.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class RoleConfig:
+    name: str
+    entrypoint: str  # "pkg.module" (run as python -m) or "pkg.module:fn"
+    total: int = 1
+    per_group: int = 1
+    envs: Dict[str, str] = field(default_factory=dict)
+    args: List[str] = field(default_factory=list)
+    resource: Dict[str, float] = field(default_factory=dict)
+    # Failover: "role" restarts this role's group on failure; "job"
+    # restarts every role; "ignore" lets the process die.
+    failover_level: str = "role"
+    max_restarts: int = 3
+
+    def validate(self):
+        if not self.name:
+            raise ValueError("role name required")
+        if not self.entrypoint:
+            raise ValueError(f"role {self.name}: entrypoint required")
+        if self.total < 1:
+            raise ValueError(f"role {self.name}: total must be >= 1")
+        if self.per_group < 1 or self.total % self.per_group != 0:
+            raise ValueError(
+                f"role {self.name}: total ({self.total}) must be a "
+                f"multiple of per_group ({self.per_group})"
+            )
+        if self.failover_level not in ("role", "job", "ignore"):
+            raise ValueError(
+                f"role {self.name}: bad failover level "
+                f"{self.failover_level!r}"
+            )
+
+
+@dataclass
+class DLJobConfig:
+    job_name: str = "unified-job"
+    roles: List[RoleConfig] = field(default_factory=list)
+    # Roles sharing a collocation group are packed onto the same
+    # node-slot (reference with_collocation / STRICT_PACK placement).
+    collocations: List[List[str]] = field(default_factory=list)
+    node_num: int = 1
+    global_envs: Dict[str, str] = field(default_factory=dict)
+    master_state_path: str = ""
+
+    def role(self, name: str) -> Optional[RoleConfig]:
+        for r in self.roles:
+            if r.name == name:
+                return r
+        return None
+
+    def validate(self):
+        if not self.roles:
+            raise ValueError("job needs at least one role")
+        names = [r.name for r in self.roles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate role names: {names}")
+        for r in self.roles:
+            r.validate()
+        for group in self.collocations:
+            for name in group:
+                if self.role(name) is None:
+                    raise ValueError(
+                        f"collocation references unknown role {name!r}"
+                    )

@@ -1,0 +1,53 @@
+"""Unified job driver CLI.
+
+Parity: reference dlrover/python/unified/driver/main.py:58 — submit a job
+described as JSON:
+
+    python -m dlrover_tpu.unified.driver job.json
+
+JSON shape mirrors DLJobConfig::
+
+    {"job_name": "demo", "node_num": 1,
+     "roles": [{"name": "trainer", "entrypoint": "my.module",
+                "total": 2, "per_group": 1, "envs": {}, "args": []}],
+     "collocations": [["trainer"]]}
+"""
+
+import json
+import sys
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.config import DLJobConfig, RoleConfig
+from dlrover_tpu.unified.manager import JobStage
+from dlrover_tpu.unified.master import submit
+
+
+def config_from_json(payload: dict) -> DLJobConfig:
+    roles = [RoleConfig(**r) for r in payload.get("roles", [])]
+    return DLJobConfig(
+        job_name=payload.get("job_name", "unified-job"),
+        roles=roles,
+        collocations=payload.get("collocations", []),
+        node_num=payload.get("node_num", 1),
+        global_envs=payload.get("global_envs", {}),
+        master_state_path=payload.get("master_state_path", ""),
+    )
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    with open(argv[0]) as f:
+        config = config_from_json(json.load(f))
+    try:
+        master = submit(config)
+    except RuntimeError as e:
+        logger.error("%s", e)
+        return 1
+    return 0 if master.status() == JobStage.SUCCEEDED else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

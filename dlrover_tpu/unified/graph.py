@@ -1,0 +1,76 @@
+"""Execution graph: roles -> scheduled worker vertices.
+
+Parity: reference dlrover/python/unified/controller/schedule/graph.py:312
+(DLExecutionGraph) + scheduler.py gang placement. Each vertex is one
+worker process of a role; vertices of collocated roles that share a
+group index land in the same placement bundle (the STRICT_PACK analogue
+— on the local backend a bundle is just a shared host slot).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from dlrover_tpu.unified.config import DLJobConfig, RoleConfig
+
+
+@dataclass
+class Vertex:
+    role: str
+    rank: int  # rank within the role
+    world_size: int  # role total
+    group_index: int  # which group (bundle) this vertex belongs to
+    bundle_id: int = -1
+    envs: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return f"{self.role}-{self.rank}"
+
+
+@dataclass
+class ExecutionGraph:
+    vertices: List[Vertex] = field(default_factory=list)
+    bundles: Dict[int, List[Vertex]] = field(default_factory=dict)
+
+    def by_role(self, role: str) -> List[Vertex]:
+        return [v for v in self.vertices if v.role == role]
+
+
+def build_execution_graph(config: DLJobConfig) -> ExecutionGraph:
+    graph = ExecutionGraph()
+    # Map each role to its collocation group (roles not mentioned get
+    # their own).
+    colloc_of: Dict[str, int] = {}
+    for i, group in enumerate(config.collocations):
+        for name in group:
+            colloc_of[name] = i
+    next_solo = len(config.collocations)
+    for role in config.roles:
+        if role.name not in colloc_of:
+            colloc_of[role.name] = next_solo
+            next_solo += 1
+
+    # Bundles: (collocation group, group_index) -> bundle id. Collocated
+    # roles must have the same number of groups for PACK to make sense.
+    bundle_ids: Dict[tuple, int] = {}
+
+    def bundle_for(role_name: str, group_index: int) -> int:
+        key = (colloc_of[role_name], group_index)
+        if key not in bundle_ids:
+            bundle_ids[key] = len(bundle_ids)
+        return bundle_ids[key]
+
+    for role in config.roles:
+        for rank in range(role.total):
+            group_index = rank // role.per_group
+            vertex = Vertex(
+                role=role.name,
+                rank=rank,
+                world_size=role.total,
+                group_index=group_index,
+                envs={**config.global_envs, **role.envs},
+            )
+            vertex.bundle_id = bundle_for(role.name, group_index)
+            graph.vertices.append(vertex)
+            graph.bundles.setdefault(vertex.bundle_id, []).append(vertex)
+    return graph

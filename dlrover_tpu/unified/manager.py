@@ -1,0 +1,239 @@
+"""PrimeManager: the unified job's state machine + failover.
+
+Parity: reference dlrover/python/unified/controller/manager.py:88-797
+(PrimeManager: INIT/READY/RUNNING/STOPPING FSM; prepare -> create
+actors -> start; per-role / job-level failover; state persisted to a
+MasterStateBackend for master self-failover).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.backend import Backend, LocalProcessBackend, WorkerHandle
+from dlrover_tpu.unified.config import DLJobConfig
+from dlrover_tpu.unified.graph import ExecutionGraph, build_execution_graph
+from dlrover_tpu.unified.state_backend import (
+    MasterStateBackend,
+    build_state_backend,
+)
+
+
+class JobStage:
+    INIT = "INIT"
+    READY = "READY"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+
+class PrimeManager:
+    def __init__(
+        self,
+        config: DLJobConfig,
+        backend: Optional[Backend] = None,
+        state_backend: Optional[MasterStateBackend] = None,
+        monitor_interval_s: float = 0.5,
+    ):
+        config.validate()
+        self.config = config
+        self.backend = backend or LocalProcessBackend()
+        self.state_backend = state_backend or build_state_backend(
+            config.master_state_path
+        )
+        self.graph: ExecutionGraph = build_execution_graph(config)
+        self.stage = JobStage.INIT
+        self._handles: Dict[str, WorkerHandle] = {}
+        self._role_restarts: Dict[str, int] = {
+            r.name: 0 for r in config.roles
+        }
+        self._job_restarts = 0
+        self._monitor_interval_s = monitor_interval_s
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        self._restore_state()
+
+    # ---- persistence --------------------------------------------------------
+
+    def _persist(self):
+        self.state_backend.save(
+            {
+                "stage": self.stage,
+                "role_restarts": self._role_restarts,
+                "job_restarts": self._job_restarts,
+            }
+        )
+
+    def _restore_state(self):
+        state = self.state_backend.load()
+        if state:
+            self._role_restarts.update(state.get("role_restarts", {}))
+            self._job_restarts = state.get("job_restarts", 0)
+            logger.info(
+                "restored manager state: restarts=%s", self._role_restarts
+            )
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def prepare(self):
+        """INIT -> READY (graph built, backend warm)."""
+        if self.stage != JobStage.INIT:
+            return
+        self.stage = JobStage.READY
+        self._persist()
+
+    def start(self):
+        """READY -> RUNNING: launch every vertex."""
+        if self.stage not in (JobStage.INIT, JobStage.READY):
+            raise RuntimeError(f"cannot start from stage {self.stage}")
+        self.prepare()
+        with self._lock:
+            for vertex in self.graph.vertices:
+                self._launch(vertex)
+        self.stage = JobStage.RUNNING
+        self._persist()
+        logger.info(
+            "unified job %s running: %d workers across %d roles",
+            self.config.job_name,
+            len(self.graph.vertices),
+            len(self.config.roles),
+        )
+
+    def _launch(self, vertex):
+        role = self.config.role(vertex.role)
+        self._handles[vertex.name] = self.backend.start_worker(
+            vertex, role, self.config.job_name
+        )
+
+    # ---- supervision --------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        """Supervise until the job finishes; returns the final stage."""
+        deadline = time.time() + timeout if timeout else None
+        while not self._stopped.is_set():
+            if deadline and time.time() > deadline:
+                break
+            done = self._tick()
+            if done:
+                break
+            time.sleep(self._monitor_interval_s)
+        return self.stage
+
+    def _tick(self) -> bool:
+        with self._lock:
+            exited: Dict[str, int] = {}
+            for name, handle in self._handles.items():
+                code = self.backend.poll(handle)
+                if code is not None:
+                    exited[name] = code
+            failures = {n: c for n, c in exited.items() if c != 0}
+            if failures:
+                return self._handle_failures(failures)
+            if len(exited) == len(self._handles):
+                self.stage = JobStage.SUCCEEDED
+                self._persist()
+                return True
+            return False
+
+    def _handle_failures(self, failures: Dict[str, int]) -> bool:
+        failed_roles = sorted(
+            {self._vertex_of(n).role for n in failures}
+        )
+        logger.warning(
+            "unified workers failed: %s (roles %s)",
+            failures,
+            failed_roles,
+        )
+        # Strongest failover level among the failed roles wins.
+        levels = {
+            self.config.role(r).failover_level for r in failed_roles
+        }
+        if "job" in levels:
+            return self._job_failover()
+        for role_name in failed_roles:
+            role = self.config.role(role_name)
+            if role.failover_level == "ignore":
+                # Drop the dead handles: an ignored role's crash must not
+                # keep re-entering failure handling or block success.
+                for name in list(failures):
+                    if self._vertex_of(name).role == role_name:
+                        logger.info(
+                            "ignoring failed worker %s (failover=ignore)",
+                            name,
+                        )
+                        del self._handles[name]
+                continue
+            if self._role_restarts[role_name] >= role.max_restarts:
+                logger.error(
+                    "role %s exhausted %d restarts; failing job",
+                    role_name,
+                    role.max_restarts,
+                )
+                self._fail()
+                return True
+            self._role_restarts[role_name] += 1
+            self._restart_role(role_name)
+        self._persist()
+        if not self._handles:
+            # Every worker was an ignored failure: nothing left to run.
+            self.stage = JobStage.SUCCEEDED
+            self._persist()
+            return True
+        return False
+
+    def _restart_role(self, role_name: str):
+        """Stop + relaunch every vertex of the role (gang restart, the
+        reference's per-role failover)."""
+        logger.info("restarting role %s (gang)", role_name)
+        for vertex in self.graph.by_role(role_name):
+            handle = self._handles.get(vertex.name)
+            if handle is not None:
+                self.backend.stop_worker(handle)
+            self._launch(vertex)
+
+    def _job_failover(self) -> bool:
+        role_budget = max(r.max_restarts for r in self.config.roles)
+        if self._job_restarts >= role_budget:
+            logger.error("job-level restarts exhausted; failing")
+            self._fail()
+            return True
+        self._job_restarts += 1
+        logger.warning(
+            "job-level failover #%d: restarting all roles",
+            self._job_restarts,
+        )
+        for handle in self._handles.values():
+            self.backend.stop_worker(handle)
+        for vertex in self.graph.vertices:
+            self._launch(vertex)
+        self._persist()
+        return False
+
+    def _fail(self):
+        self.stage = JobStage.FAILED
+        self._persist()
+        self._stop_all()
+
+    def _vertex_of(self, name: str):
+        return self._handles[name].vertex
+
+    # ---- stop ---------------------------------------------------------------
+
+    def stop(self):
+        self._stopped.set()
+        with self._lock:
+            if self.stage == JobStage.RUNNING:
+                self.stage = JobStage.STOPPING
+            self._stop_all()
+            if self.stage == JobStage.STOPPING:
+                self.stage = JobStage.SUCCEEDED
+            self._persist()
+
+    def _stop_all(self):
+        for handle in self._handles.values():
+            try:
+                self.backend.stop_worker(handle)
+            except Exception:
+                logger.warning("worker stop failed", exc_info=True)

@@ -1,0 +1,59 @@
+"""PrimeMaster facade + submit().
+
+Parity: reference dlrover/python/unified/controller/master.py (PrimeMaster
+detached actor; status/stop/wait RPC) and driver/main.py:24-74
+(submit(JobConfig)). Locally the master is an in-process object whose
+manager supervises subprocess workers; a Ray deployment wraps the same
+PrimeManager in a detached actor.
+"""
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.config import DLJobConfig
+from dlrover_tpu.unified.manager import JobStage, PrimeManager
+
+
+class PrimeMaster:
+    def __init__(self, config: DLJobConfig, backend=None, state_backend=None):
+        self._manager = PrimeManager(
+            config, backend=backend, state_backend=state_backend
+        )
+        self._wait_thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def create(cls, config: DLJobConfig, **kwargs) -> "PrimeMaster":
+        return cls(config, **kwargs)
+
+    def start(self):
+        self._manager.start()
+        self._wait_thread = threading.Thread(
+            target=self._manager.wait, name="prime-wait", daemon=True
+        )
+        self._wait_thread.start()
+
+    def status(self) -> str:
+        return self._manager.stage
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        if self._wait_thread is not None:
+            self._wait_thread.join(timeout)
+        return self._manager.stage
+
+    def stop(self):
+        self._manager.stop()
+
+
+def submit(
+    config: DLJobConfig, blocking: bool = True, **kwargs
+) -> PrimeMaster:
+    """Run a unified job (reference driver.main submit())."""
+    master = PrimeMaster.create(config, **kwargs)
+    master.start()
+    if blocking:
+        stage = master.wait()
+        logger.info("unified job %s finished: %s", config.job_name, stage)
+        if stage != JobStage.SUCCEEDED:
+            raise RuntimeError(f"job {config.job_name} ended in {stage}")
+    return master

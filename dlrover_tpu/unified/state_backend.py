@@ -1,0 +1,65 @@
+"""Master runtime-state persistence for self-failover.
+
+Parity: reference dlrover/python/unified/controller/state_backend.py
+(in-memory / Ray-internal-KV) — here: in-memory and atomic-file JSON.
+A restarted PrimeMaster reloads the job stage and per-role restart
+counts so failover budgets survive the master itself dying.
+"""
+
+import json
+import os
+from typing import Dict, Optional
+
+
+class MasterStateBackend:
+    def save(self, state: Dict):
+        raise NotImplementedError
+
+    def load(self) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def clear(self):
+        raise NotImplementedError
+
+
+class InMemoryStateBackend(MasterStateBackend):
+    def __init__(self):
+        self._state: Optional[Dict] = None
+
+    def save(self, state: Dict):
+        self._state = json.loads(json.dumps(state))
+
+    def load(self) -> Optional[Dict]:
+        return self._state
+
+    def clear(self):
+        self._state = None
+
+
+class FileStateBackend(MasterStateBackend):
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def save(self, state: Dict):
+        tmp = f"{self._path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.rename(tmp, self._path)
+
+    def load(self) -> Optional[Dict]:
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear(self):
+        try:
+            os.remove(self._path)
+        except FileNotFoundError:
+            pass
+
+
+def build_state_backend(path: str = "") -> MasterStateBackend:
+    return FileStateBackend(path) if path else InMemoryStateBackend()

@@ -1,0 +1,187 @@
+"""Unified layer tests: DSL, graph, FSM, failover on the local-process
+backend (mirrors reference unified integration tests on local Ray)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.unified import DLJobBuilder, PrimeMaster, submit
+from dlrover_tpu.unified.backend import UnifiedEnv
+from dlrover_tpu.unified.graph import build_execution_graph
+from dlrover_tpu.unified.manager import JobStage, PrimeManager
+from dlrover_tpu.unified.state_backend import FileStateBackend
+
+
+# ---- builder/config ---------------------------------------------------------
+
+
+def test_builder_dsl_builds_valid_config():
+    job = (
+        DLJobBuilder("ppo")
+        .nnodes(2)
+        .role("trainer").run("m.t").total(4).per_group(2)
+        .env("A", "1").add()
+        .role("rollout").run("m.r").total(4).per_group(2).add()
+        .with_collocation("trainer", "rollout")
+        .build()
+    )
+    assert job.job_name == "ppo"
+    assert job.role("trainer").envs == {"A": "1"}
+    assert job.collocations == [["trainer", "rollout"]]
+
+
+def test_builder_validation_errors():
+    with pytest.raises(ValueError):
+        DLJobBuilder().build()  # no roles
+    with pytest.raises(ValueError):
+        DLJobBuilder().role("a").run("m").total(3).per_group(2).add().build()
+    with pytest.raises(ValueError):
+        (
+            DLJobBuilder()
+            .role("a").run("m").add()
+            .with_collocation("a", "ghost")
+            .build()
+        )
+
+
+def test_execution_graph_collocation_bundles():
+    job = (
+        DLJobBuilder()
+        .role("trainer").run("m.t").total(4).per_group(2).add()
+        .role("rollout").run("m.r").total(2).per_group(1).add()
+        .with_collocation("trainer", "rollout")
+        .build()
+    )
+    graph = build_execution_graph(job)
+    assert len(graph.vertices) == 6
+    # trainer group 0 (ranks 0,1) shares a bundle with rollout group 0.
+    t0 = [v for v in graph.by_role("trainer") if v.group_index == 0]
+    r0 = [v for v in graph.by_role("rollout") if v.group_index == 0]
+    assert {v.bundle_id for v in t0} == {r0[0].bundle_id}
+
+
+# ---- end-to-end on local backend --------------------------------------------
+
+_OK_SCRIPT = (
+    "import os,sys,time; time.sleep(0.2); "
+    "open(os.environ['OUT'] + '.' + os.environ['DLROVER_TPU_ROLE'] + "
+    "os.environ['DLROVER_TPU_ROLE_RANK'], 'w').write('done')"
+)
+
+
+def _write_worker(tmp_path, name, body):
+    path = tmp_path / f"{name}.py"
+    path.write_text(body)
+    return str(tmp_path), name
+
+
+def test_submit_runs_roles_to_success(tmp_path, monkeypatch):
+    moddir, mod = _write_worker(
+        tmp_path,
+        "okworker",
+        "import os, time\n"
+        "def main():\n"
+        "    time.sleep(0.2)\n"
+        "    tag = os.environ['DLROVER_TPU_ROLE'] + "
+        "os.environ['DLROVER_TPU_ROLE_RANK']\n"
+        "    open(os.environ['OUT'] + '.' + tag, 'w').write('done')\n"
+        "main()\n",
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    out = str(tmp_path / "out")
+    job = (
+        DLJobBuilder("okjob")
+        .role("trainer").run(mod).total(2).env("OUT", out).add()
+        .role("judge").run(mod).total(1).env("OUT", out).add()
+        .build()
+    )
+    master = submit(job)
+    assert master.status() == JobStage.SUCCEEDED
+    for tag in ("trainer0", "trainer1", "judge0"):
+        assert (tmp_path / f"out.{tag}").exists()
+
+
+def test_role_failover_restarts_gang(tmp_path, monkeypatch):
+    # Worker crashes on its first incarnation, succeeds after restart
+    # (uses a marker file to detect the incarnation).
+    moddir, mod = _write_worker(
+        tmp_path,
+        "flaky",
+        "import os, sys, time\n"
+        "def main():\n"
+        "    marker = os.environ['OUT'] + '.first.' + "
+        "os.environ['DLROVER_TPU_ROLE_RANK']\n"
+        "    if not os.path.exists(marker):\n"
+        "        open(marker, 'w').write('x')\n"
+        "        sys.exit(1)\n"
+        "    time.sleep(0.1)\n"
+        "main()\n",
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    out = str(tmp_path / "flaky_out")
+    job = (
+        DLJobBuilder("flakyjob")
+        .role("trainer").run(mod).total(2).env("OUT", out)
+        .max_restarts(2).add()
+        .build()
+    )
+    master = submit(job)
+    assert master.status() == JobStage.SUCCEEDED
+
+
+def test_restart_budget_exhaustion_fails_job(tmp_path, monkeypatch):
+    moddir, mod = _write_worker(
+        tmp_path, "alwaysfail", "import sys\nsys.exit(1)\n"
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    job = (
+        DLJobBuilder("failjob")
+        .role("trainer").run(mod).total(1).max_restarts(1).add()
+        .build()
+    )
+    with pytest.raises(RuntimeError):
+        submit(job)
+
+
+def test_state_backend_survives_manager_restart(tmp_path, monkeypatch):
+    moddir, mod = _write_worker(
+        tmp_path, "noopworker", "import time\ntime.sleep(0.1)\n"
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    state_path = str(tmp_path / "state.json")
+    job = (
+        DLJobBuilder("persistjob")
+        .role("trainer").run(mod).total(1).add()
+        .master_state(state_path)
+        .build()
+    )
+    manager = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    manager._role_restarts["trainer"] = 2
+    manager._persist()
+
+    # A new master over the same state file resumes the budget.
+    manager2 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    assert manager2._role_restarts["trainer"] == 2
+
+
+def test_ignore_role_failure_does_not_fail_job(tmp_path, monkeypatch):
+    moddir, _ = _write_worker(
+        tmp_path, "okshort", "import time\ntime.sleep(0.4)\n"
+    )
+    _write_worker(tmp_path, "crasher", "import sys\nsys.exit(1)\n")
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    job = (
+        DLJobBuilder("ignorejob")
+        .role("trainer").run("okshort").total(1).add()
+        .role("logger").run("crasher").total(1).failover("ignore").add()
+        .build()
+    )
+    master = submit(job)
+    assert master.status() == JobStage.SUCCEEDED
