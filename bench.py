@@ -25,6 +25,22 @@ MTBF_S = 3600.0          # assumed failure interval at scale (1/h)
 SAVE_EVERY_S = 60.0      # flash-ckpt cadence at the operating point
 
 
+def probe_d2h_bandwidth_mbs() -> float:
+    """Measured device->host MB/s: flash-ckpt save cost is dominated by
+    this, and it varies ~1000x between a local PCIe TPU and a tunneled
+    dev chip. The bench sizes its model so one state transfer stays
+    bounded regardless."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.ones((2 * 1024 * 1024,), jnp.float32)  # 8 MB
+    jax.block_until_ready(x)
+    t0 = time.time()
+    np.asarray(x)
+    return 8.0 / max(time.time() - t0, 1e-6)
+
+
 def build(platform: str):
     import jax
 
@@ -36,17 +52,33 @@ def build(platform: str):
         cfg = llama.tiny_config()
         batch, seq, steps = 8, 64, 20
     else:
-        cfg = llama.TpuLMConfig(
-            vocab_size=32000,
-            embed_dim=1024,
-            n_layers=24,
-            n_heads=16,
-            n_kv_heads=8,
-            head_dim=64,
-            mlp_dim=4096,
-            dtype="bfloat16",
-        )
-        batch, seq, steps = 8, 1024, 30
+        bw = probe_d2h_bandwidth_mbs()
+        if bw < 100.0:
+            # Tunneled/remote chip: keep the train state small enough
+            # that a full shm save stays ~10s at the measured bandwidth.
+            cfg = llama.TpuLMConfig(
+                vocab_size=4096,
+                embed_dim=256,
+                n_layers=4,
+                n_heads=8,
+                n_kv_heads=4,
+                head_dim=32,
+                mlp_dim=1024,
+                dtype="bfloat16",
+            )
+            batch, seq, steps = 8, 512, 24
+        else:
+            cfg = llama.TpuLMConfig(
+                vocab_size=32000,
+                embed_dim=1024,
+                n_layers=24,
+                n_heads=16,
+                n_kv_heads=8,
+                head_dim=64,
+                mlp_dim=4096,
+                dtype="bfloat16",
+            )
+            batch, seq, steps = 8, 1024, 30
 
     n = len(jax.devices())
     mesh = build_mesh(MeshConfig(dp=n), jax.devices())
@@ -85,6 +117,7 @@ def main():
     engine = CheckpointEngine(ckpt_dir, standalone=True)
     save_times, step_times = [], []
     restore_s = replay_s = 0.0
+    drain_s = 0.0
     # Preempt mid-interval so a real replay is exercised.
     preempt_step = (
         (steps // 2) // save_interval * save_interval + save_interval // 2
@@ -94,9 +127,16 @@ def main():
     while int(state["step"]) < steps:
         cur = int(state["step"])
         if cur % save_interval == 0 and cur > 0:
-            save_times.append(engine.save_to_memory(cur, state))
+            # Async flash save: the training thread only launches the
+            # device->host DMA; the transfer overlaps the next steps.
+            save_times.append(engine.save_to_memory_async(cur, state))
         if cur == preempt_at:
             preempt_at = -1
+            # Only a LANDED snapshot is restorable; measure the drain of
+            # the in-flight one (overlapped with the steps just trained).
+            t0 = time.time()
+            engine.wait_async_save()
+            drain_s = time.time() - t0
             # Preemption: device state is gone; restore from host memory.
             del state
             t0 = time.time()
@@ -117,6 +157,9 @@ def main():
         state, metrics = step_fn(state, batch_d)
         jax.block_until_ready(metrics["loss"])
         step_times.append(time.time() - t0)
+    final_drain = time.time()
+    engine.wait_async_save()
+    final_drain = time.time() - final_drain
     total_wall = time.time() - wall_start
     engine.close()
 
@@ -136,7 +179,10 @@ def main():
     replay_ratio = (
         replay_s / (lost_steps * step_s) if lost_steps else 1.0
     )  # replay speed vs clean speed (~1.0 when jit cache is warm)
-    expected_replay = (SAVE_EVERY_S / 2.0) * max(replay_ratio, 1.0)
+    # An async snapshot lags the step it captured by its drain time, so
+    # the expected lost window is half the cadence plus the drain.
+    lag = max(drain_s, final_drain)
+    expected_replay = (SAVE_EVERY_S / 2.0 + lag) * max(replay_ratio, 1.0)
     downtime = restore_s + expected_replay
     overhead = saves_per_mtbf * save_block_s
     goodput = 100.0 * MTBF_S / (MTBF_S + overhead + downtime)
@@ -152,6 +198,7 @@ def main():
                 "model_params_m": round(cfg.count_params() / 1e6, 1),
                 "raw_run_goodput": round(raw_goodput, 2),
                 "ckpt_save_block_s": round(save_block_s, 4),
+                "ckpt_drain_s": round(max(drain_s, final_drain), 4),
                 "ckpt_restore_s": round(restore_s, 4),
                 "replay_s": round(replay_s, 4),
                 "step_time_s": round(step_s, 4),

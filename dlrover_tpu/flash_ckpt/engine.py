@@ -81,6 +81,18 @@ class CheckpointEngine:
             self._event_queue = SharedQueueClient(CKPT_EVENT_QUEUE)
         self._last_save_time = 0.0
         self._last_disk_step = -1  # newest step a disk save was requested for
+        # Async snapshot pipeline: the training thread only LAUNCHES the
+        # device->host DMA; a writer thread materializes the arrays (the
+        # np conversion completes the in-flight transfer) and writes shm.
+        import threading
+
+        self._snap_cond = threading.Condition()
+        self._pending_snapshot = None  # (step, state, user_meta)
+        self._writing_step = -1
+        self._last_written_step = -1
+        self._write_error: Optional[BaseException] = None
+        self._writer_thread = None
+        self._writer_stop = False
 
     # ---- save --------------------------------------------------------------
 
@@ -121,10 +133,111 @@ class CheckpointEngine:
             elapsed = time.time() - start
             span.content["block_s"] = elapsed
         self._last_save_time = time.time()
+        self._last_written_step = max(self._last_written_step, step)
         logger.info(
             "flash ckpt step %d -> shm in %.3fs", step, elapsed
         )
         return elapsed
+
+    def save_to_memory_async(
+        self,
+        step: int,
+        state: Any,
+        user_meta: Optional[Dict[str, Any]] = None,
+    ) -> float:
+        """Non-blocking save: launch device->host DMA and return.
+
+        The TPU flash-checkpoint hot path: ``copy_to_host_async`` starts
+        the transfer, compute on the next step overlaps with the DMA, and
+        a writer thread lands the bytes in shm when they arrive. The
+        caller must NOT donate the passed state to later steps (keep
+        ``donate=False`` on the jitted step, or pass a copy).
+
+        Returns the blocking seconds (async-copy launch cost, ~ms even
+        for multi-GB states).
+        """
+        import jax
+
+        start = time.time()
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        with self._snap_cond:
+            if self._pending_snapshot is not None:
+                logger.info(
+                    "dropping unwritten snapshot of step %d for step %d",
+                    self._pending_snapshot[0],
+                    step,
+                )
+            self._pending_snapshot = (step, state, user_meta)
+            self._ensure_writer()
+            self._snap_cond.notify_all()
+        elapsed = time.time() - start
+        logger.info(
+            "flash ckpt step %d async-launched in %.4fs", step, elapsed
+        )
+        return elapsed
+
+    def wait_async_save(self, timeout: float = 600.0) -> bool:
+        """Block until every launched snapshot has landed in shm.
+
+        False on timeout OR if the last write failed (the caller must
+        not assume the launched step is restorable)."""
+        deadline = time.time() + timeout
+        with self._snap_cond:
+            while (
+                self._pending_snapshot is not None
+                or self._writing_step >= 0
+            ):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._snap_cond.wait(min(remaining, 1.0))
+            return self._write_error is None
+
+    def _ensure_writer(self):
+        import threading
+
+        if self._writer_thread is None or not self._writer_thread.is_alive():
+            self._writer_stop = False
+            self._writer_thread = threading.Thread(
+                target=self._writer_loop, name="ckpt-writer", daemon=True
+            )
+            self._writer_thread.start()
+
+    def _writer_loop(self):
+        while True:
+            with self._snap_cond:
+                while self._pending_snapshot is None:
+                    if self._writer_stop:
+                        return
+                    self._snap_cond.wait(1.0)
+                step, state, user_meta = self._pending_snapshot
+                self._pending_snapshot = None
+                if step <= self._last_written_step:
+                    # A direct save_to_memory of a NEWER step landed while
+                    # this snapshot waited: writing it would regress shm.
+                    logger.info(
+                        "skipping stale async snapshot of step %d "
+                        "(step %d already in shm)",
+                        step,
+                        self._last_written_step,
+                    )
+                    self._snap_cond.notify_all()
+                    continue
+                self._writing_step = step
+            try:
+                self.save_to_memory(step, state, user_meta)
+                with self._snap_cond:
+                    self._write_error = None
+            except Exception as e:
+                logger.exception("async snapshot write failed")
+                with self._snap_cond:
+                    self._write_error = e
+            finally:
+                with self._snap_cond:
+                    self._writing_step = -1
+                    self._snap_cond.notify_all()
 
     def save_to_storage(
         self,
@@ -217,6 +330,18 @@ class CheckpointEngine:
         )
 
     def close(self):
+        drained = self.wait_async_save(timeout=60.0)
+        with self._snap_cond:
+            self._writer_stop = True
+            self._snap_cond.notify_all()
+        if not drained:
+            logger.error(
+                "async snapshot did not drain cleanly before close; the "
+                "newest launched step may not be restorable from memory"
+            )
+        # Let the writer finish/exit before closing shm under it.
+        if self._writer_thread is not None:
+            self._writer_thread.join(timeout=10.0)
         self._shm.close()
 
 

@@ -83,6 +83,13 @@ class DistributedJobMaster:
         if diagnosis_master is None and with_diagnosis:
             diagnosis_master = self._build_diagnosis_master(pre_check)
         self.diagnosis_master = diagnosis_master
+        from dlrover_tpu.master.hyperparams.simple_strategy_generator import (
+            SimpleStrategyGenerator,
+        )
+
+        self.job_manager.set_strategy_generator(
+            SimpleStrategyGenerator(self.job_manager)
+        )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,

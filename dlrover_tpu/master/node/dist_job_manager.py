@@ -390,8 +390,14 @@ class DistributedJobManager:
     def get_committed_ckpt_step(self) -> int:
         return self._job_context.committed_ckpt_step()
 
+    def set_strategy_generator(self, generator):
+        self._strategy_generator = generator
+
     def get_parallel_config(self) -> Optional[comm.ParallelConfig]:
-        return None
+        generator = getattr(self, "_strategy_generator", None)
+        if generator is None:
+            return None
+        return generator.generate()
 
     def get_job_detail(self) -> comm.JobDetailResponse:
         nodes = {}

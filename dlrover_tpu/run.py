@@ -191,6 +191,11 @@ def run(args) -> int:
     monitor = ResourceMonitor(client)
     monitor.start()
 
+    from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
+
+    paral_tuner = ParalConfigTuner(client)
+    paral_tuner.start()
+
     timer_collectors = []
     if get_env_bool("DLROVER_TPU_TIMER"):
         from dlrover_tpu.diagnosis.collectors import TpuTimerMetricCollector
@@ -266,6 +271,7 @@ def run(args) -> int:
 
     result = agent.run()
     monitor.stop()
+    paral_tuner.stop()
     for c in timer_collectors:
         c.stop()
     if result == RunResult.SUCCEEDED:

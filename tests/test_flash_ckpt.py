@@ -169,3 +169,47 @@ def test_commit_protocol_multi_node(tmp_path, monkeypatch):
     assert ok
     assert ckpt_storage.read_tracker(ckpt_dir) == 4
     _cleanup(ckpt)
+
+
+def test_async_save_lands_and_overlaps(tmp_path):
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    engine = CheckpointEngine(str(tmp_path / "ackpt"), standalone=True)
+    try:
+        state = {"w": jnp.arange(1024, dtype=jnp.float32), "step": jnp.int32(3)}
+        block = engine.save_to_memory_async(3, state)
+        # The launch must be far cheaper than a synchronous device_get
+        # of the same state (it only starts the DMA).
+        assert block < 1.0
+        assert engine.wait_async_save(timeout=30)
+        loaded = engine.load()
+        assert loaded is not None
+        step, np_state, _ = loaded
+        assert step == 3
+        np.testing.assert_array_equal(
+            np.asarray(np_state["w"]), np.arange(1024, dtype=np.float32)
+        )
+    finally:
+        engine._shm.unlink()
+        engine.close()
+
+
+def test_async_save_coalesces_to_newest(tmp_path):
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    engine = CheckpointEngine(str(tmp_path / "ackpt2"), standalone=True)
+    try:
+        for step in (1, 2, 3):
+            engine.save_to_memory_async(
+                step, {"w": jnp.full((8,), float(step))}
+            )
+        assert engine.wait_async_save(timeout=30)
+        step, np_state, _ = engine.load()
+        # Intermediate snapshots may be dropped; the NEWEST must land.
+        assert step == 3
+        np.testing.assert_array_equal(
+            np.asarray(np_state["w"]), np.full((8,), 3.0)
+        )
+    finally:
+        engine._shm.unlink()
+        engine.close()

@@ -1,0 +1,133 @@
+"""Strategy generator + agent-side parallel-config tuner tests."""
+
+import json
+import time
+
+import pytest
+
+from dlrover_tpu.agent.paral_config_tuner import (
+    ParalConfigTuner,
+    read_parallel_config,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus, NodeType
+from dlrover_tpu.common.node import NodeGroupResource
+from dlrover_tpu.master.hyperparams.simple_strategy_generator import (
+    SimpleStrategyGenerator,
+    _balanced_mesh,
+)
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_context import JobContext
+from dlrover_tpu.testing.sim_cluster import (
+    SimCluster,
+    SimNodeWatcher,
+    SimScaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_manager(node_num=2):
+    cluster = SimCluster()
+    mgr = DistributedJobManager(
+        job_name="hp-job",
+        node_groups={NodeType.WORKER: NodeGroupResource(count=node_num)},
+        scaler=SimScaler("hp-job", cluster),
+        watcher=SimNodeWatcher("hp-job", cluster),
+    )
+    mgr.start()
+    assert wait_until(
+        lambda: len(
+            [
+                n
+                for n in mgr.worker_manager.nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+        )
+        == node_num
+    )
+    return mgr, cluster
+
+
+def test_balanced_mesh_shapes():
+    assert _balanced_mesh(1) == {"dp": 1}
+    assert _balanced_mesh(8) == {"fsdp": 8}
+    assert _balanced_mesh(12) == {"dp": 3, "fsdp": 4}
+
+
+def test_generator_suggests_batching():
+    mgr, _ = make_manager(2)
+    try:
+        gen = SimpleStrategyGenerator(
+            mgr, global_batch_size=64, devices_per_node=4
+        )
+        config = gen.generate()
+        # 8 devices, global 64 -> share 8 -> micro 8, accum 1.
+        assert config.micro_batch_size == 8
+        assert config.grad_accum_steps == 1
+        assert config.mesh_shape == {"fsdp": 8}
+        assert config.version == 1
+        # Unchanged world: same version (no churn for the tuner).
+        assert gen.generate().version == 1
+    finally:
+        mgr.stop()
+
+
+def test_generator_remat_after_oom():
+    mgr, _ = make_manager(1)
+    try:
+        gen = SimpleStrategyGenerator(
+            mgr, global_batch_size=8, devices_per_node=4
+        )
+        assert gen.generate().remat_policy == ""
+        node = list(mgr.worker_manager.nodes.values())[0]
+        node.exit_reason = NodeExitReason.OOM
+        config = gen.generate()
+        assert config.remat_policy == "full"
+        assert config.version == 2
+    finally:
+        mgr.stop()
+
+
+def test_tuner_writes_file_on_new_version(tmp_path):
+    class FakeClient:
+        def __init__(self):
+            self.version = 1
+
+        def get_parallel_config(self):
+            return comm.ParallelConfig(
+                micro_batch_size=4,
+                grad_accum_steps=2,
+                mesh_shape={"dp": 2},
+                version=self.version,
+            )
+
+    client = FakeClient()
+    path = str(tmp_path / "paral.json")
+    tuner = ParalConfigTuner(client, config_path=path, interval_s=3600)
+    assert tuner.tune_once()
+    data = read_parallel_config(path)
+    assert data["micro_batch_size"] == 4 and data["version"] == 1
+    # Same version again: no rewrite.
+    assert not tuner.tune_once()
+    client.version = 2
+    assert tuner.tune_once()
+    assert read_parallel_config(path)["version"] == 2
+
+
+def test_read_parallel_config_missing():
+    assert read_parallel_config("/nonexistent/paral.json") is None
