@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 
 CONFIG_FILE_ENV = "DLROVER_TPU_PARAL_CONFIG_FILE"
@@ -35,7 +36,7 @@ class ParalConfigTuner:
     ):
         self._client = master_client
         self._path = config_path or default_config_path(
-            os.getenv("DLROVER_TPU_JOB_NAME", "job")
+            os.getenv(NodeEnv.JOB_NAME, "job")
         )
         self._interval_s = interval_s
         # Start at 0: the master's "no suggestion yet" sentinel is a

@@ -34,8 +34,12 @@ CKPT_LOCK_PREFIX = "ckpt_shm"
 
 
 def shm_segment_name(local_rank: int) -> str:
+    """Per-worker shm segment. The node rank is part of the name so
+    same-host multi-node setups (tests, packed dev boxes) never collide;
+    agent and workers of one node share the same NODE_RANK env."""
     job = os.getenv(NodeEnv.JOB_NAME, "job")
-    return f"dlrover_tpu_ckpt_{job}_{local_rank}"
+    node_rank = os.getenv(NodeEnv.NODE_RANK, "0")
+    return f"dlrover_tpu_ckpt_{job}_n{node_rank}_{local_rank}"
 
 
 class SaveEvent:
@@ -87,6 +91,11 @@ class CheckpointEngine:
         import threading
 
         self._snap_cond = threading.Condition()
+        # Serializes ALL shm writes in this process (training thread's
+        # direct saves vs the async writer thread); the UDS SharedLock
+        # only guards against the agent, not intra-process races, and is
+        # absent entirely in standalone mode.
+        self._save_mutex = threading.Lock()
         self._pending_snapshot = None  # (step, state, user_meta)
         self._writing_step = -1
         self._last_written_step = -1
@@ -108,6 +117,23 @@ class CheckpointEngine:
         from dlrover_tpu.training_event import TrainerEvents
 
         start = time.time()
+        with self._save_mutex:
+            if step < self._last_written_step:
+                # shm must only move forward: an older (async) snapshot
+                # racing a newer direct save is refused, not written.
+                logger.warning(
+                    "refusing to write step %d over newer shm step %d",
+                    step,
+                    self._last_written_step,
+                )
+                return 0.0
+            return self._save_to_memory_locked(step, state, user_meta, start)
+
+    def _save_to_memory_locked(self, step, state, user_meta, start):
+        import jax
+
+        from dlrover_tpu.training_event import TrainerEvents
+
         with TrainerEvents.ckpt_save_memory(step) as span:
             jax.block_until_ready(state)
             meta = dict(user_meta or {})
@@ -342,6 +368,14 @@ class CheckpointEngine:
         # Let the writer finish/exit before closing shm under it.
         if self._writer_thread is not None:
             self._writer_thread.join(timeout=10.0)
+            if self._writer_thread.is_alive():
+                # Never close the segment under an in-progress write: a
+                # leaked handle beats a torn snapshot. The daemon thread
+                # dies with the process.
+                logger.error(
+                    "ckpt writer still running at close; leaving shm open"
+                )
+                return
         self._shm.close()
 
 

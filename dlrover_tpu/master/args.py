@@ -35,6 +35,18 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="serve the web dashboard on this port (-1 = off, 0 = auto)",
     )
     parser.add_argument(
+        "--global_batch_size",
+        type=int,
+        default=0,
+        help="job global batch (enables micro-batch/accum suggestions)",
+    )
+    parser.add_argument(
+        "--devices_per_node",
+        type=int,
+        default=4,
+        help="TPU chips per worker host (mesh suggestions)",
+    )
+    parser.add_argument(
         "--auto_scale",
         action="store_true",
         default=False,

@@ -53,6 +53,8 @@ class DistributedJobMaster:
         auto_scale: bool = False,
         legal_worker_counts=None,
         dashboard_port: int = -1,
+        global_batch_size: int = 0,
+        devices_per_node: int = 4,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -88,7 +90,11 @@ class DistributedJobMaster:
         )
 
         self.job_manager.set_strategy_generator(
-            SimpleStrategyGenerator(self.job_manager)
+            SimpleStrategyGenerator(
+                self.job_manager,
+                global_batch_size=global_batch_size,
+                devices_per_node=devices_per_node,
+            )
         )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
@@ -215,6 +221,8 @@ class DistributedJobMaster:
             auto_scale=getattr(args, "auto_scale", False),
             legal_worker_counts=legal_counts,
             dashboard_port=getattr(args, "dashboard_port", -1),
+            global_batch_size=getattr(args, "global_batch_size", 0),
+            devices_per_node=getattr(args, "devices_per_node", 4),
         )
 
     # ---- lifecycle ---------------------------------------------------------

@@ -213,8 +213,15 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                 MasterEvents.rdzv_round(
                     self.name, self._rdzv_round - 1, len(self._latest_world)
                 )
-            if node_rank in self._latest_world:
+            if (
+                node_rank in self._latest_world
+                and node_rank not in self._waiting
+            ):
                 return self._rdzv_round - 1, 0, dict(self._latest_world)
+            # Waiting for the next round: a node that RE-joined (its
+            # worker died and it came back) must never be handed the
+            # stale world it used to belong to — that world may contain
+            # dead peers and would make it restart-loop against them.
             return self._rdzv_round, 0, {}
 
 

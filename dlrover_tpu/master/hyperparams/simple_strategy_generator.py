@@ -65,7 +65,21 @@ class SimpleStrategyGenerator:
         accum = 1
         if self._global_batch_size > 0 and micro > 0:
             denom = micro * n_devices
-            accum = max(1, math.ceil(self._global_batch_size / denom))
+            if self._global_batch_size % denom != 0:
+                # A fixed global batch must divide exactly — rounding up
+                # would silently train on a bigger batch. Leave the
+                # batching knobs unset and let the trainer keep its own.
+                logger.warning(
+                    "global batch %d not divisible by micro(%d) x "
+                    "devices(%d); batching suggestion withheld",
+                    self._global_batch_size,
+                    micro,
+                    n_devices,
+                )
+                micro = 0
+                accum = 0
+            else:
+                accum = self._global_batch_size // denom
         config = comm.ParallelConfig(
             micro_batch_size=micro,
             grad_accum_steps=accum,

@@ -1,0 +1,126 @@
+"""Elastic re-mesh e2e: a 2-node JAX job loses a node permanently and
+continues at world=1 with the global state resharded from storage —
+the universal-checkpoint analogue, end to end through real agents,
+real jax.distributed worker processes, and the master rendezvous.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import ElasticAgent, RunResult, WorkerSpec
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.diagnosis.actions import NodeAction
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+
+WORKER = os.path.join(os.path.dirname(__file__), "workers", "remesh_train.py")
+
+TOTAL_STEPS = 30
+GLOBAL = 8
+
+
+@pytest.fixture()
+def env_isolation(monkeypatch, tmp_path):
+    job = f"remesh_t{time.time_ns() % 1000000}"
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", job)
+    monkeypatch.setenv("DLROVER_TPU_SHARED_DIR", str(tmp_path / "uds"))
+    yield tmp_path
+
+
+def read_lines(out_base):
+    lines = []
+    for pid in (0, 1):
+        path = f"{out_base}.{pid}"
+        if not os.path.exists(path):
+            continue
+        for line in open(path):
+            proc, world, step, w_sum = line.split()
+            lines.append((int(proc), int(world), int(step), float(w_sum)))
+    return lines
+
+
+def test_node_loss_remesh_and_resharded_resume(env_isolation, tmp_path):
+    JobContext.reset_singleton()
+    master = LocalJobMaster(port=0, node_num=2)
+    master.prepare()
+    # Elastic window: the job may continue at 1 node.
+    master.rdzv_managers[RendezvousName.TRAINING].update_rdzv_params(
+        min_nodes=1, max_nodes=2, waiting_timeout=3.0
+    )
+    out = str(tmp_path / "progress")
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def make_agent(rank, max_restarts):
+        os.environ["DLROVER_TPU_NODE_RANK"] = str(rank)
+        client = MasterClient(f"localhost:{master.port}", node_id=rank)
+        spec = WorkerSpec(
+            entrypoint=WORKER,
+            args=[str(TOTAL_STEPS), out, ckpt_dir],
+            nproc_per_node=1,
+            max_restarts=max_restarts,
+            node_rank=rank,
+            monitor_interval=0.2,
+            env={"DLROVER_TPU_NODE_RANK": str(rank)},
+        )
+        return ElasticAgent(spec, client)
+
+    agent0 = make_agent(0, max_restarts=3)
+    # Node 1 "dies for good": its agent has no restart budget, so a
+    # worker kill escalates straight to node failure.
+    agent1 = make_agent(1, max_restarts=0)
+    results = {}
+
+    def run(name, agent):
+        results[name] = agent.run()
+
+    t0 = threading.Thread(target=run, args=("a0", agent0), daemon=True)
+    t1 = threading.Thread(target=run, args=("a1", agent1), daemon=True)
+    t0.start()
+    t1.start()
+
+    # Phase 1: both nodes train at world=2.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        lines = read_lines(out)
+        if len([ln for ln in lines if ln[1] == 2 and ln[2] >= 4]) >= 2:
+            break
+        time.sleep(0.2)
+    lines = read_lines(out)
+    assert any(ln[1] == 2 for ln in lines), f"never reached world=2: {lines}"
+
+    # Kill node 1's worker permanently (agent1 fails the node).
+    assert agent1._workers
+    os.kill(agent1._workers[0].process.pid, signal.SIGKILL)
+    t1.join(timeout=60)
+    assert results.get("a1") == RunResult.FAILED
+
+    # The master (diagnosis) tells node 0 to restart its workers so the
+    # job re-meshes without the dead peer (reference restart path).
+    get_job_context().enqueue_action(
+        NodeAction(instance=0, node_id=0, reason="peer node lost")
+    )
+
+    t0.join(timeout=150)
+    assert results.get("a0") == RunResult.SUCCEEDED
+
+    lines = read_lines(out)
+    world1 = [ln for ln in lines if ln[0] == 0 and ln[1] == 1]
+    assert world1, f"never re-meshed to world=1: {lines}"
+    # Training finished and the state carried over the re-mesh: after
+    # step N, w == N on every element, so sum == N * GLOBAL regardless
+    # of how the array was sharded when it was saved.
+    final = max(world1, key=lambda ln: ln[2])
+    assert final[2] == TOTAL_STEPS
+    assert final[3] == pytest.approx(TOTAL_STEPS * GLOBAL)
+    # The first world=1 step resumed from a checkpoint, not from zero.
+    first_w1 = min(world1, key=lambda ln: ln[2])
+    assert first_w1[2] > 1, "re-meshed worker restarted from scratch"
+    assert first_w1[3] == pytest.approx(first_w1[2] * GLOBAL)
+
+    master.stop()
+    JobContext.reset_singleton()

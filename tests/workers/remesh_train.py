@@ -1,0 +1,85 @@
+"""Elastic re-mesh training worker.
+
+Trains a globally-sharded parameter over however many JAX processes the
+agent's rendezvous produced, flash-checkpointing to storage each step.
+When the world changes between incarnations (a node died), the restore
+path reassembles the global state from every process's storage shards
+and re-shards it under the NEW mesh — the reference's DeepSpeed
+universal-checkpoint flow (training.py:1548), nearly free in JAX.
+
+Progress lines: "<process_id> <world> <step> <w_sum>".
+"""
+
+import os
+import re
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# One local device per process: the test harness may export a virtual
+# 8-device count (conftest), which would blow up the global device count.
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=1"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.flash_ckpt.engine import CheckpointEngine, to_device_state
+from dlrover_tpu.trainer.runtime import init_distributed
+
+GLOBAL = 8  # global parameter length (divisible by any test world size)
+
+
+def main():
+    total_steps = int(sys.argv[1])
+    out_path = sys.argv[2]
+    ckpt_dir = sys.argv[3]
+
+    ctx = init_distributed()
+    mesh = Mesh(jax.devices(), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    engine = CheckpointEngine(ckpt_dir, standalone=True)
+
+    start = 0
+    restored = engine.load()
+    if restored is not None:
+        start, np_state, _ = restored
+        state = to_device_state(
+            np_state, {"w": sharding, "step": NamedSharding(mesh, P())}
+        )
+    else:
+        state = {
+            "w": jax.device_put(
+                jnp.zeros((GLOBAL,), jnp.float32), sharding
+            ),
+            "step": jnp.int32(0),
+        }
+
+    @jax.jit
+    def train_step(s):
+        w = s["w"] + 1.0
+        return {"w": w, "step": s["step"] + 1}, jnp.sum(w)
+
+    for step in range(start + 1, total_steps + 1):
+        state, w_sum = train_step(state)
+        jax.block_until_ready(w_sum)
+        engine.save_to_storage(step, state)
+        with open(f"{out_path}.{ctx.process_id}", "a") as f:
+            f.write(
+                f"{ctx.process_id} {ctx.num_processes} {step} "
+                f"{float(w_sum)}\n"
+            )
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main()
