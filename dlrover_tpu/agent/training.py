@@ -201,7 +201,22 @@ class ElasticAgent:
                 outcome.process_id_base + local_rank,
             )
 
-    def _stop_workers(self, timeout: float = 15.0):
+    def _stop_workers(self, timeout: float = 15.0, post_mortem: bool = False):
+        if post_mortem:
+            # Failure/hang stop: SIGUSR2 makes workers dump all-thread
+            # stacks into their logs (a worker wedged in a collective
+            # tells us where), then a grace period lets faulthandler
+            # finish writing before SIGTERM lands.
+            dumped = False
+            for w in self._workers:
+                if w.process.poll() is None:
+                    try:
+                        os.kill(w.process.pid, signal.SIGUSR2)
+                        dumped = True
+                    except (ProcessLookupError, OSError):
+                        pass
+            if dumped:
+                time.sleep(0.5)
         for w in self._workers:
             if w.process.poll() is None:
                 try:
@@ -224,9 +239,9 @@ class ElasticAgent:
                 w.log_file.close()
                 w.log_file = None
 
-    def _restart_workers(self):
+    def _restart_workers(self, post_mortem: bool = False):
         restart_start = time.time()
-        self._stop_workers()
+        self._stop_workers(post_mortem=post_mortem)
         self._restart_count += 1
         self._initialize_workers()
         self._client.report_goodput_phase(
@@ -263,8 +278,10 @@ class ElasticAgent:
         for action in actions or []:
             atype = getattr(action, "action_type", None)
             if atype == DiagnosisActionType.RESTART_WORKER:
+                # Diagnosis-driven restart usually means a hang: capture
+                # stacks before tearing the workers down.
                 logger.info("diagnosis action: restart workers in place")
-                self._restart_workers()
+                self._restart_workers(post_mortem=True)
             elif atype == DiagnosisActionType.RELAUNCH_WORKER:
                 logger.info("diagnosis action: relaunch node")
                 self._stop_workers()
@@ -322,7 +339,9 @@ class ElasticAgent:
                 "max restarts (%d) exhausted", self._spec.max_restarts
             )
             return RunResult.FAILED
-        self._restart_workers()
+        # Some workers may still be alive while siblings crashed; their
+        # stacks are evidence for the failure diagnosis.
+        self._restart_workers(post_mortem=True)
         return None
 
     # ---- main loop ---------------------------------------------------------

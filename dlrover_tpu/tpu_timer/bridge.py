@@ -200,3 +200,10 @@ class TpuTimer:
 
 def get_timer() -> TpuTimer:
     return TpuTimer.get()
+
+
+def active_timer() -> Optional[TpuTimer]:
+    """The timer IF something already initialized it, else None — for
+    callers (tracing decorators, GC hooks) that must never trigger the
+    first-use native build as a side effect."""
+    return TpuTimer._instance

@@ -76,6 +76,20 @@ def init_distributed(timeout_secs: int = 300) -> DistributedContext:
             initialization_timeout=timeout_secs,
         )
         ctx.initialized_jax_distributed = True
+    # Always: SIGUSR2 (agent hang post-mortem) must never be fatal, and
+    # faulthandler costs nothing until the signal arrives.
+    try:
+        from dlrover_tpu.tpu_timer.py_tracing import (
+            install_stack_dump_handler,
+        )
+
+        install_stack_dump_handler()
+    except Exception:
+        logger.warning(
+            "stack dump handler unavailable; SIGUSR2 will be fatal to "
+            "workers",
+            exc_info=True,
+        )
     _maybe_start_tpu_timer(ctx)
     _context = ctx
     return ctx
@@ -94,6 +108,7 @@ def _maybe_start_tpu_timer(ctx: DistributedContext):
     try:
         from dlrover_tpu.tpu_timer import get_timer
         from dlrover_tpu.tpu_timer.bridge import publish_port
+        from dlrover_tpu.tpu_timer.py_tracing import trace_gc
 
         timer = get_timer()
         port = timer.start_server(18889 + ctx.local_rank)
@@ -101,6 +116,7 @@ def _maybe_start_tpu_timer(ctx: DistributedContext):
             port = timer.start_server(0)
         if port:
             publish_port(ctx.local_rank, port)
+        trace_gc()
     except Exception:
         logger.warning("tpu_timer daemon failed to start", exc_info=True)
 

@@ -152,3 +152,74 @@ def test_span_name_sanitized_for_json(timer, tmp_path):
         trace = json.load(f)  # must parse
     assert any("restore" in e["name"] for e in trace["traceEvents"])
     parse_prometheus_text(timer.metrics_text())  # must not blow up
+
+
+def test_gc_tracing_records_spans(timer):
+    import gc
+
+    from dlrover_tpu.tpu_timer.py_tracing import trace_gc, untrace_gc
+
+    trace_gc()
+    try:
+        gc.collect()
+    finally:
+        untrace_gc()
+    metrics = parse_prometheus_text(timer.metrics_text())
+    gc_spans = [k for k in metrics if "py_gc_gen" in k]
+    assert gc_spans, metrics.keys()
+
+
+def test_traced_decorator(timer):
+    from dlrover_tpu.tpu_timer.py_tracing import traced
+
+    @traced(name="fetch_batch")
+    def fetch():
+        return 42
+
+    assert fetch() == 42
+    metrics = parse_prometheus_text(timer.metrics_text())
+    assert metrics["tpu_timer_span_count/fetch_batch"] >= 1
+
+
+def test_stack_dump_to_file(tmp_path):
+    from dlrover_tpu.tpu_timer.py_tracing import dump_stacks
+
+    path = tmp_path / "stacks.txt"
+    with open(path, "w") as f:
+        dump_stacks(f)
+    text = path.read_text()
+    assert "test_stack_dump_to_file" in text
+
+
+def test_sigusr2_dumps_and_does_not_kill(tmp_path):
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import sys, time\n"
+        "from dlrover_tpu.tpu_timer.py_tracing import "
+        "install_stack_dump_handler\n"
+        "install_stack_dump_handler()\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+    )
+    assert proc.stdout.readline().strip() == b"ready"
+    os.kill(proc.pid, signal.SIGUSR2)
+    _time.sleep(0.5)
+    assert proc.poll() is None  # survived the dump signal
+    proc.terminate()
+    _, err = proc.communicate(timeout=10)
+    assert b"Thread" in err or b"File" in err  # traceback was dumped
