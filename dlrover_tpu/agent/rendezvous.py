@@ -97,11 +97,14 @@ class MasterRendezvousHandler:
                 f"{self._join_timeout}s"
             )
 
-        ranks = sorted(world)
+        # The master chooses the world ORDER (possibly topology-aware:
+        # slice-mates adjacent, DCN hops only at block boundaries) and
+        # the dict preserves it over the wire; global process ids follow
+        # that order, not numeric node rank.
+        ranks = list(world)
         num_processes = sum(world.values())
-        process_id_base = sum(
-            world[r] for r in ranks if r < self._node_rank
-        )
+        my_pos = ranks.index(self._node_rank)
+        process_id_base = sum(world[r] for r in ranks[:my_pos])
         coordinator_rank = ranks[0]
         is_coordinator = coordinator_rank == self._node_rank
         key = self._coordinator_key(rdzv_round, group)

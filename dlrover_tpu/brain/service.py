@@ -1,0 +1,190 @@
+"""Brain service: cluster-level stats store + resource optimization.
+
+Parity: reference dlrover/go/brain (gRPC ``optimize`` /
+``persist_metrics``, MySQL datastore, optimizer plugins) — re-scoped to
+a lightweight HTTP service with a JSON-file datastore: masters report
+runtime samples and job completions; ``optimize`` answers with a worker
+count learned from completed jobs of the same job name (the cross-job
+memory a single-job local optimizer cannot have).
+
+Run: ``python -m dlrover_tpu.brain.service --port 8600 --data_dir /var/brain``
+"""
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+class BrainStore:
+    """Append-only JSON-lines store of job samples and completions."""
+
+    def __init__(self, data_dir: str):
+        self._dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, kind: str) -> str:
+        return os.path.join(self._dir, f"{kind}.jsonl")
+
+    def append(self, kind: str, record: Dict):
+        record = dict(record)
+        record["ts"] = time.time()
+        path = self._path(kind)
+        with self._lock:
+            # A crash mid-append can leave a torn final line; appending
+            # straight after it would merge (and lose) this record too.
+            needs_newline = False
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        needs_newline = f.read(1) != b"\n"
+            except OSError:
+                pass
+            with open(path, "a") as f:
+                if needs_newline:
+                    f.write("\n")
+                f.write(json.dumps(record) + "\n")
+
+    def load(self, kind: str) -> List[Dict]:
+        records = []
+        try:
+            with open(self._path(kind)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                    except ValueError:
+                        continue  # torn line from a crash mid-append
+                    if isinstance(record, dict):
+                        records.append(record)
+        except OSError:
+            pass
+        return records
+
+
+class BrainOptimizer:
+    """Cross-job heuristic: among past runs of this job name, prefer the
+    worker count with the best observed speed-per-worker (cost-adjusted
+    throughput)."""
+
+    def __init__(self, store: BrainStore):
+        self._store = store
+
+    def optimize(self, job_name: str) -> Optional[Dict]:
+        samples = []
+        for s in self._store.load("runtime"):
+            if s.get("job_name") != job_name:
+                continue
+            try:
+                speed = float(s.get("speed", 0))
+                count = int(s.get("worker_count", 0))
+            except (TypeError, ValueError):
+                continue  # records are caller-supplied; skip junk
+            if speed > 0 and count > 0:
+                samples.append((count, speed))
+        if not samples:
+            return None
+        by_count: Dict[int, List[float]] = {}
+        for count, speed in samples:
+            by_count.setdefault(count, []).append(speed)
+        best_count, best_value = 0, -1.0
+        for count, speeds in by_count.items():
+            if count <= 0:
+                continue
+            value = (sum(speeds) / len(speeds)) / count
+            if value > best_value:
+                best_count, best_value = count, value
+        if best_count <= 0:
+            return None
+        return {"worker_count": best_count, "evidence_samples": len(samples)}
+
+
+class BrainService:
+    def __init__(self, port: int = 0, data_dir: str = "/tmp/dlrover_brain"):
+        self.store = BrainStore(data_dir)
+        self.optimizer = BrainOptimizer(self.store)
+        self._server = ThreadingHTTPServer(
+            ("0.0.0.0", port), self._make_handler()
+        )
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "bad json"})
+                    return
+                if self.path == "/persist_metrics":
+                    kind = body.get("kind", "runtime")
+                    if kind not in ("runtime", "completion"):
+                        self._json(400, {"error": f"bad kind {kind}"})
+                        return
+                    service.store.append(kind, body.get("record", {}))
+                    self._json(200, {"ok": True})
+                elif self.path == "/optimize":
+                    plan = service.optimizer.optimize(
+                        body.get("job_name", "")
+                    )
+                    self._json(200, {"plan": plan})
+                else:
+                    self._json(404, {"error": "not found"})
+
+        return Handler
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="brain", daemon=True
+        )
+        self._thread.start()
+        logger.info("brain service on port %d", self.port)
+
+    def stop(self):
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="dlrover-tpu brain")
+    parser.add_argument("--port", type=int, default=8600)
+    parser.add_argument("--data_dir", type=str, default="/tmp/dlrover_brain")
+    args = parser.parse_args(argv)
+    service = BrainService(args.port, args.data_dir)
+    service.start()
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,18 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="serve the web dashboard on this port (-1 = off, 0 = auto)",
     )
     parser.add_argument(
+        "--brain_addr",
+        type=str,
+        default="",
+        help="host:port of a brain service (cross-job stats + optimizer)",
+    )
+    parser.add_argument(
+        "--topology_aware",
+        action="store_true",
+        default=False,
+        help="order ranks by network topology (slice-mates adjacent)",
+    )
+    parser.add_argument(
         "--global_batch_size",
         type=int,
         default=0,

@@ -16,6 +16,7 @@ from dlrover_tpu.common.constants import (
     JobConstant,
     JobExitReason,
     NodeType,
+    RendezvousName,
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import NodeGroupResource, NodeResource
@@ -55,6 +56,8 @@ class DistributedJobMaster:
         dashboard_port: int = -1,
         global_batch_size: int = 0,
         devices_per_node: int = 4,
+        brain_addr: str = "",
+        topology_aware: bool = False,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -109,10 +112,29 @@ class DistributedJobMaster:
         self._stopped = threading.Event()
         self.exit_reason = ""
 
+        if topology_aware:
+            from dlrover_tpu.master.elastic_training.net_topology import (
+                DpTopologySorter,
+            )
+
+            training_rdzv = self.rdzv_managers.get(RendezvousName.TRAINING)
+            if training_rdzv is not None and hasattr(
+                training_rdzv, "set_topology_sorter"
+            ):
+                training_rdzv.set_topology_sorter(DpTopologySorter())
+
         from dlrover_tpu.master.stats.job_collector import JobMetricCollector
 
+        stats_reporter = None
+        if brain_addr:
+            from dlrover_tpu.brain.client import BrainStatsReporter
+
+            stats_reporter = BrainStatsReporter(brain_addr, job_name)
         self.metric_collector = JobMetricCollector(
-            job_name, self.job_manager, self.perf_monitor
+            job_name,
+            self.job_manager,
+            self.perf_monitor,
+            reporter=stats_reporter,
         )
         self.dashboard = None
         if dashboard_port >= 0:
@@ -126,18 +148,25 @@ class DistributedJobMaster:
             from dlrover_tpu.master.node.job_auto_scaler import (
                 AllreduceTrainingAutoScaler,
             )
-            from dlrover_tpu.master.resource.optimizer import (
-                AllreduceLocalOptimizer,
-            )
 
-            self.auto_scaler = AllreduceTrainingAutoScaler(
-                self.job_manager,
-                scaler,
-                AllreduceLocalOptimizer(
+            if brain_addr:
+                from dlrover_tpu.brain.client import BrainResourceOptimizer
+
+                optimizer = BrainResourceOptimizer(brain_addr, job_name)
+            else:
+                from dlrover_tpu.master.resource.optimizer import (
+                    AllreduceLocalOptimizer,
+                )
+
+                optimizer = AllreduceLocalOptimizer(
                     self.job_manager,
                     self.perf_monitor,
                     legal_counts=legal_worker_counts,
-                ),
+                )
+            self.auto_scaler = AllreduceTrainingAutoScaler(
+                self.job_manager,
+                scaler,
+                optimizer,
                 rdzv_managers=self.rdzv_managers,
             )
 
@@ -223,6 +252,8 @@ class DistributedJobMaster:
             dashboard_port=getattr(args, "dashboard_port", -1),
             global_batch_size=getattr(args, "global_batch_size", 0),
             devices_per_node=getattr(args, "devices_per_node", 4),
+            brain_addr=getattr(args, "brain_addr", ""),
+            topology_aware=getattr(args, "topology_aware", False),
         )
 
     # ---- lifecycle ---------------------------------------------------------

@@ -179,6 +179,24 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 
     def __init__(self):
         super().__init__(RendezvousName.TRAINING)
+        self._topology_sorter = None
+
+    def set_topology_sorter(self, sorter):
+        """Install a TopologySorter (net_topology.DpTopologySorter): the
+        completed world's ORDER then follows physical blocks, and agents
+        assign process ids in that order."""
+        self._topology_sorter = sorter
+
+    def _order_world(self, world: Dict[int, int], chosen) -> Dict[int, int]:
+        if self._topology_sorter is None:
+            return dict(sorted(world.items()))
+        node_ips = {w.node_rank: w.node_ip for w in chosen}
+        try:
+            order = self._topology_sorter.sort(world, node_ips)
+        except Exception:
+            logger.exception("topology sort failed; numeric order used")
+            return dict(sorted(world.items()))
+        return {rank: world[rank] for rank in order}
 
     def get_comm_world(self, node_rank: int):
         with self._lock:
@@ -195,7 +213,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                 world = {
                     w.node_rank: w.local_world_size for w in chosen
                 }
-                self._latest_world = dict(sorted(world.items()))
+                self._latest_world = self._order_world(world, chosen)
                 for w in chosen:
                     del self._waiting[w.node_rank]
                 if self._waiting:

@@ -196,6 +196,28 @@ def run(args) -> int:
     paral_tuner = ParalConfigTuner(client)
     paral_tuner.start()
 
+    # User-pluggable failover extension (reference
+    # trainer/torch/elastic_run.py:550 _setup_dynamic_failover_extension):
+    # DLROVER_TPU_FAILOVER_EXT="pkg.module:factory" -> factory(client,
+    # node_rank) returning a DiagnosisAgent-compatible object.
+    diagnosis_agent = None
+    ext_spec = os.getenv("DLROVER_TPU_FAILOVER_EXT", "")
+    if ext_spec:
+        try:
+            module_name, factory_name = ext_spec.split(":", 1)
+            import importlib
+
+            module = importlib.import_module(module_name)
+            diagnosis_agent = getattr(module, factory_name)(
+                client, node_rank
+            )
+            logger.info("loaded failover extension %s", ext_spec)
+        except Exception:
+            logger.exception(
+                "failover extension %r failed to load; using default",
+                ext_spec,
+            )
+
     timer_collectors = []
     if get_env_bool("DLROVER_TPU_TIMER"):
         from dlrover_tpu.diagnosis.collectors import TpuTimerMetricCollector
@@ -260,7 +282,9 @@ def run(args) -> int:
     saver = AsyncCheckpointSaver.start_async_saving_ckpt(
         client=client, replica_manager=replica_manager
     )
-    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    agent = ElasticAgent(
+        spec, client, ckpt_saver=saver, diagnosis_agent=diagnosis_agent
+    )
 
     def _signal_handler(signum, frame):
         logger.info("launcher received signal %d; stopping workers", signum)

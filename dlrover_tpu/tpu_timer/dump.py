@@ -1,0 +1,58 @@
+"""Timeline/metrics fetch CLI.
+
+Parity: reference py_xpu_timer tools (gen_trace_timeline.py, dump
+driver) — the daemon already serves a chrome-trace JSON, so the tool is
+a fetch-and-save:
+
+    python -m dlrover_tpu.tpu_timer.dump --port 18889 --out trace.json
+    python -m dlrover_tpu.tpu_timer.dump --port 18889 --metrics
+
+Open the JSON in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+import argparse
+import http.client
+import sys
+
+
+def fetch(port: int, path: str, host: str = "127.0.0.1") -> bytes:
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"GET {path} -> {resp.status}")
+        return resp.read()
+    finally:
+        conn.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="tpu_timer dump tool")
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=18889)
+    parser.add_argument("--out", type=str, default="tpu_timer_trace.json")
+    parser.add_argument(
+        "--metrics",
+        action="store_true",
+        help="print Prometheus metrics instead of saving the timeline",
+    )
+    args = parser.parse_args(argv)
+    try:
+        if args.metrics:
+            sys.stdout.write(
+                fetch(args.port, "/metrics", args.host).decode()
+            )
+            return 0
+        data = fetch(args.port, "/timeline", args.host)
+        with open(args.out, "wb") as f:
+            f.write(data)
+        print(f"timeline saved to {args.out} ({len(data)} bytes)")
+        return 0
+    except (OSError, RuntimeError) as e:
+        print(f"fetch failed: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""In-worker runtime helpers for unified jobs.
+
+Parity: reference dlrover/python/unified/api/runtime
+(current_worker() etc.) — a worker launched by the unified backend reads
+its role coordinates from the injected env.
+"""
+
+import os
+from dataclasses import dataclass
+
+from dlrover_tpu.unified.backend import UnifiedEnv
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    job_name: str
+    role: str
+    rank: int
+    world_size: int
+    group_index: int
+    bundle_id: int
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+
+def current_worker() -> WorkerInfo:
+    """Coordinates of this process within its unified job (all defaults
+    when run outside one)."""
+    return WorkerInfo(
+        job_name=os.getenv(UnifiedEnv.JOB_NAME, ""),
+        role=os.getenv(UnifiedEnv.ROLE, ""),
+        rank=int(os.getenv(UnifiedEnv.ROLE_RANK, "0")),
+        world_size=int(os.getenv(UnifiedEnv.ROLE_WORLD_SIZE, "1")),
+        group_index=int(os.getenv(UnifiedEnv.GROUP_INDEX, "0")),
+        bundle_id=int(os.getenv(UnifiedEnv.BUNDLE_ID, "-1")),
+    )

@@ -1,0 +1,144 @@
+"""Brain service/client, topology sorter, unified runtime helpers."""
+
+import pytest
+
+from dlrover_tpu.brain.client import (
+    BrainResourceOptimizer,
+    BrainStatsReporter,
+)
+from dlrover_tpu.brain.service import BrainService
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.master.elastic_training.net_topology import (
+    DpTopologySorter,
+    SubnetTopologyQuerier,
+)
+from dlrover_tpu.master.stats.job_collector import (
+    JobCompletionRecord,
+    RuntimeMetricSample,
+)
+from dlrover_tpu.unified.runtime import current_worker
+
+
+# ---- topology ---------------------------------------------------------------
+
+
+def test_subnet_querier_blocks():
+    q = SubnetTopologyQuerier()
+    assert q.block_of(0, "10.1.2.3") == "10.1.2"
+    assert q.block_of(1, "10.1.2.9") == "10.1.2"
+    assert q.block_of(2, "10.1.3.3") == "10.1.3"
+    assert q.block_of(3, "") == ""
+
+
+def test_dp_topology_sorter_groups_slices():
+    sorter = DpTopologySorter()
+    world = {0: 1, 1: 1, 2: 1, 3: 1}
+    # ranks 0,2 share slice A; 1,3 share slice B
+    ips = {0: "10.0.1.1", 1: "10.0.2.1", 2: "10.0.1.2", 3: "10.0.2.2"}
+    assert sorter.sort(world, ips) == [0, 2, 1, 3]
+
+
+# ---- brain ------------------------------------------------------------------
+
+
+@pytest.fixture()
+def brain(tmp_path):
+    service = BrainService(port=0, data_dir=str(tmp_path / "brain"))
+    service.start()
+    yield service
+    service.stop()
+
+
+def _sample(step, speed, workers):
+    return RuntimeMetricSample(
+        timestamp=0.0,
+        global_step=step,
+        speed=speed,
+        goodput=0.9,
+        worker_count=workers,
+    )
+
+
+def test_brain_reports_and_optimizes(brain):
+    addr = f"127.0.0.1:{brain.port}"
+    reporter = BrainStatsReporter(addr, "jobA")
+    # 4 workers: 2.0 steps/s (0.5/worker). 8 workers: 2.4 (0.3/worker).
+    for _ in range(3):
+        reporter.report_runtime_sample(_sample(10, 2.0, 4))
+        reporter.report_runtime_sample(_sample(20, 2.4, 8))
+    reporter.report_job_completion(
+        JobCompletionRecord("jobA", True, "Succeeded", 100.0, 0)
+    )
+    opt = BrainResourceOptimizer(addr, "jobA")
+    plan = opt.generate_plan()
+    group = plan.node_group_resources[NodeType.WORKER]
+    assert group.count == 4  # best speed-per-worker
+    assert "brain" in plan.comment
+
+
+def test_brain_unknown_job_empty_plan(brain):
+    addr = f"127.0.0.1:{brain.port}"
+    opt = BrainResourceOptimizer(addr, "nosuchjob")
+    assert opt.generate_plan().empty()
+
+
+def test_brain_unreachable_empty_plan():
+    opt = BrainResourceOptimizer("127.0.0.1:1", "jobA")
+    assert opt.generate_plan().empty()
+
+
+# ---- unified runtime --------------------------------------------------------
+
+
+def test_current_worker_reads_env(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_ROLE", "trainer")
+    monkeypatch.setenv("DLROVER_TPU_ROLE_RANK", "2")
+    monkeypatch.setenv("DLROVER_TPU_ROLE_WORLD_SIZE", "4")
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "uj")
+    info = current_worker()
+    assert info.role == "trainer" and info.rank == 2
+    assert info.world_size == 4 and not info.is_leader
+
+
+def test_brain_survives_junk_records(brain):
+    addr = f"127.0.0.1:{brain.port}"
+    import http.client as hc
+    import json as _json
+
+    def post(path, payload):
+        conn = hc.HTTPConnection("127.0.0.1", brain.port, timeout=5)
+        conn.request("POST", path, body=_json.dumps(payload))
+        resp = conn.getresponse()
+        out = (resp.status, resp.read())
+        conn.close()
+        return out
+
+    # Junk record (missing fields, wrong types) + a torn trailing line.
+    post("/persist_metrics", {"kind": "runtime",
+                              "record": {"job_name": "junky", "speed": "NaNish"}})
+    with open(brain.store._path("runtime"), "a") as f:
+        f.write('{"job_name": "junky", "speed"')  # torn mid-append
+    reporter = BrainStatsReporter(addr, "junky")
+    reporter.report_runtime_sample(_sample(5, 1.5, 2))
+    status, body = post("/optimize", {"job_name": "junky"})
+    assert status == 200
+    plan = _json.loads(body)["plan"]
+    assert plan["worker_count"] == 2
+
+
+def test_topology_order_flows_to_agents():
+    """With a sorter installed, the completed world's order follows
+    physical blocks, and agents assign process ids by that order."""
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60)
+    mgr.set_topology_sorter(DpTopologySorter())
+    ips = {0: "10.0.1.1", 1: "10.0.2.1", 2: "10.0.1.2", 3: "10.0.2.2"}
+    for rank in range(4):
+        mgr.join_rendezvous(rank, rank, 1, node_ip=ips[rank])
+    _, _, world = mgr.get_comm_world(0)
+    # Slice-mates adjacent: 0,2 (block .1) then 1,3 (block .2).
+    assert list(world) == [0, 2, 1, 3]
