@@ -52,9 +52,11 @@ def _flash_kernel(
     k_start = ki * block_k
 
     def body():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        # Blocks are (1, bq, d) or (1, 1, bq, d) depending on the layout
+        # path; normalize to 2D for the math.
+        q = q_ref[...].reshape(block_q, -1).astype(jnp.float32) * scale
+        k = k_ref[...].reshape(block_k, -1).astype(jnp.float32)
+        v = v_ref[...].reshape(block_k, -1).astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -95,7 +97,7 @@ def _flash_kernel(
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.maximum(l, 1e-30)
         out = jnp.where(m > NEG_INF / 2, out, 0.0)
-        o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+        o_ref[...] = out.astype(o_ref.dtype).reshape(o_ref.shape)
 
 
 def _flash_forward(q, k, v, causal, softmax_scale, interpret):
@@ -107,12 +109,15 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
     block_k = _pick_block(skv)
     grid = (b * h, sq // block_q, skv // block_k)
 
-    def q_map(bh, qi, ki):
-        return (bh // h, qi, bh % h, 0)
-
-    def kv_map(bh, qi, ki):
-        return (bh // h, ki, (bh % h) // groups, 0)
-
+    # Mosaic requires the BLOCK's last two dims to be divisible by
+    # (8, 128) or equal to the full array dims; a head-dim block of 1 in
+    # the sublane position never qualifies. Two legal layouts:
+    # - d % 128 == 0: fold heads into the minor axis ([b, s, h*d] is a
+    #   FREE reshape of the contiguous layout) and block the per-head
+    #   d-slice — zero data movement;
+    # - otherwise (d=64 etc.): transpose to [b, h, s, d] so the minor
+    #   block dim equals the full array d — costs one HBM copy per
+    #   operand, still far cheaper than materialized s^2 logits.
     kernel = functools.partial(
         _flash_kernel,
         scale=scale,
@@ -120,23 +125,61 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
         block_q=block_q,
         block_k=block_k,
     )
-    return pl.pallas_call(
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+    ]
+    if d % 128 == 0 or h == 1:
+        qr = q.reshape(b, sq, h * d)
+        kr = k.reshape(b, skv, hkv * d)
+        vr = v.reshape(b, skv, hkv * d)
+
+        def q_map(bh, qi, ki):
+            return (bh // h, qi, bh % h)
+
+        def kv_map(bh, qi, ki):
+            return (bh // h, ki, (bh % h) // groups)
+
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), q_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), q_map),
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(qr, kr, vr)
+        return out.reshape(b, sq, h, d)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    def q_map4(bh, qi, ki):
+        return (bh // h, bh % h, qi, 0)
+
+    def kv_map4(bh, qi, ki):
+        return (bh // h, (bh % h) // groups, ki, 0)
+
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, d), q_map),
-            pl.BlockSpec((1, block_k, 1, d), kv_map),
-            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, 1, block_q, d), q_map4),
+            pl.BlockSpec((1, 1, block_k, d), kv_map4),
+            pl.BlockSpec((1, 1, block_k, d), kv_map4),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, d), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_map4),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
