@@ -197,6 +197,32 @@ def list_step_dirs(checkpoint_dir: str) -> List[int]:
     return sorted(steps)
 
 
+class KeepStepIntervalDeletionStrategy:
+    """Keep the newest ``max_to_keep`` steps AND every step that is a
+    multiple of ``keep_interval`` (reference storage.py
+    KeepStepIntervalStrategy): long-horizon jobs keep sparse history for
+    evaluation/rollback without unbounded disk growth."""
+
+    def __init__(self, keep_interval: int, max_to_keep: int = 3):
+        self.keep_interval = max(keep_interval, 1)
+        self.max_to_keep = max_to_keep
+
+    def clean_up(self, checkpoint_dir: str):
+        steps = list_step_dirs(checkpoint_dir)
+        committed = read_tracker(checkpoint_dir)
+        # steps[-0:] would be the WHOLE list, not "none recent".
+        recent = (
+            set(steps[-self.max_to_keep :]) if self.max_to_keep > 0 else set()
+        )
+        for s in steps:
+            if s == committed or s in recent:
+                continue
+            if s % self.keep_interval == 0:
+                continue
+            logger.info("removing old checkpoint step %d", s)
+            shutil.rmtree(step_dir(checkpoint_dir, s), ignore_errors=True)
+
+
 class KeepLatestDeletionStrategy:
     """Retain the newest ``max_to_keep`` step dirs (reference
     storage.py deletion strategies)."""

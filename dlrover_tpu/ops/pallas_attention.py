@@ -118,22 +118,16 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
     # - otherwise (d=64 etc.): transpose to [b, h, s, d] so the minor
     #   block dim equals the full array d — costs one HBM copy per
     #   operand, still far cheaper than materialized s^2 logits.
-    kernel = functools.partial(
-        _flash_kernel,
-        scale=scale,
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
-    )
-    scratch = [
-        pltpu.VMEM((block_q, d), jnp.float32),
-        pltpu.VMEM((block_q, 128), jnp.float32),
-        pltpu.VMEM((block_q, 128), jnp.float32),
-    ]
     if d % 128 == 0 or h == 1:
-        qr = q.reshape(b, sq, h * d)
-        kr = k.reshape(b, skv, hkv * d)
-        vr = v.reshape(b, skv, hkv * d)
+        # Fold heads into the minor axis: free reshape, per-head d-slice
+        # picked by the block index map.
+        operands = (
+            q.reshape(b, sq, h * d),
+            k.reshape(b, skv, hkv * d),
+            v.reshape(b, skv, hkv * d),
+        )
+        q_block = (1, block_q, d)
+        kv_block = (1, block_k, d)
 
         def q_map(bh, qi, ki):
             return (bh // h, qi, bh % h)
@@ -141,45 +135,54 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
         def kv_map(bh, qi, ki):
             return (bh // h, ki, (bh % h) // groups)
 
-        out = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), q_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), q_map),
-            scratch_shapes=scratch,
-            interpret=interpret,
-        )(qr, kr, vr)
-        return out.reshape(b, sq, h, d)
+        def post(out):
+            return out.reshape(b, sq, h, d)
 
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
+    else:
+        # Transpose to [b, h, s, d]: minor block dim equals the array's
+        # full d. One HBM copy per operand.
+        operands = (
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+        )
+        q_block = (1, 1, block_q, d)
+        kv_block = (1, 1, block_k, d)
 
-    def q_map4(bh, qi, ki):
-        return (bh // h, bh % h, qi, 0)
+        def q_map(bh, qi, ki):
+            return (bh // h, bh % h, qi, 0)
 
-    def kv_map4(bh, qi, ki):
-        return (bh // h, (bh % h) // groups, ki, 0)
+        def kv_map(bh, qi, ki):
+            return (bh // h, (bh % h) // groups, ki, 0)
 
+        def post(out):
+            return out.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(operands[0].shape, q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), q_map4),
-            pl.BlockSpec((1, 1, block_k, d), kv_map4),
-            pl.BlockSpec((1, 1, block_k, d), kv_map4),
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec(kv_block, kv_map),
+            pl.BlockSpec(kv_block, kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), q_map4),
-        scratch_shapes=scratch,
+        out_specs=pl.BlockSpec(q_block, q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    )(*operands)
+    return post(out)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

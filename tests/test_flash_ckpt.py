@@ -213,3 +213,28 @@ def test_async_save_coalesces_to_newest(tmp_path):
     finally:
         engine._shm.unlink()
         engine.close()
+
+
+def test_keep_step_interval_deletion(tmp_path):
+    import os as _os
+
+    from dlrover_tpu.flash_ckpt.storage import (
+        KeepStepIntervalDeletionStrategy,
+        step_dir,
+        write_tracker,
+    )
+
+    root = str(tmp_path / "hist")
+    for s in (10, 20, 25, 30, 35, 40, 45):
+        _os.makedirs(step_dir(root, s))
+    write_tracker(root, 45)
+    KeepStepIntervalDeletionStrategy(keep_interval=20, max_to_keep=2).clean_up(
+        root
+    )
+    kept = sorted(
+        int(d.split("-")[-1])
+        for d in _os.listdir(root)
+        if d.startswith("checkpoint-")
+    )
+    # Multiples of 20 survive (20, 40), plus the 2 newest (40, 45).
+    assert kept == [20, 40, 45]

@@ -22,9 +22,18 @@ def _qkv(key, b, s, h, hkv, d):
 
 
 @pytest.mark.parametrize("causal", [True, False])
-@pytest.mark.parametrize("hkv", [4, 2])
-def test_flash_matches_dense(causal, hkv):
-    q, k, v = _qkv(jax.random.key(0), 2, 64, 4, hkv, 16)
+@pytest.mark.parametrize(
+    "h,hkv,d",
+    [
+        (4, 4, 16),   # MHA, transpose layout path
+        (4, 2, 16),   # GQA, transpose layout path
+        (4, 4, 128),  # MHA, fold-heads layout path (d % 128 == 0)
+        (4, 2, 128),  # GQA, fold-heads layout path
+        (1, 1, 16),   # single head, fold-heads path via h == 1
+    ],
+)
+def test_flash_matches_dense(causal, h, hkv, d):
+    q, k, v = _qkv(jax.random.key(0), 2, 64, h, hkv, d)
     ref = dot_product_attention(q, k, v, causal=causal)
     out = jax.jit(
         lambda q, k, v: flash_attention(q, k, v, causal, None, True)

@@ -135,3 +135,25 @@ def test_pre_check_and_config(master, client):
 def test_cluster_version(master, client):
     client.update_cluster_version("local", 3, "worker", 0)
     assert client.get_cluster_version("local", "worker", 0) == 3
+
+
+def test_http_transport_full_protocol():
+    """The HTTP transport flavor serves the same two-verb protocol
+    (reference servicer.py:994 HttpMasterServicer)."""
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    JobContext.reset_singleton()
+    m = LocalJobMaster(port=0, node_num=1, transport="http")
+    m.prepare()
+    try:
+        c = MasterClient(f"localhost:{m.port}", node_id=0, kind="http")
+        assert c.wait_master_ready(30)
+        c.kv_store_set("hk", b"v1")
+        assert c.kv_store_get("hk") == b"v1"
+        c.join_rendezvous(0, 1, RendezvousName.TRAINING)
+        _, _, world = c.get_comm_world(RendezvousName.TRAINING, 0)
+        assert world == {0: 1}
+        c.close()
+    finally:
+        m.stop()
+        JobContext.reset_singleton()
