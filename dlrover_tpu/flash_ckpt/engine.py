@@ -140,6 +140,11 @@ class CheckpointEngine:
             meta["process_id"] = self._ctx.process_id
             meta["num_processes"] = self._ctx.num_processes
             meta["local_rank"] = self._local_rank
+            # Identity stamp: a segment left behind by a DIFFERENT job
+            # that happened to share the shm name must never be restored.
+            # realpath: '/a/ckpt/' vs '/a/ckpt' vs symlink spellings of
+            # the same dir must not false-reject our own image.
+            meta["ckpt_dir"] = os.path.realpath(self.checkpoint_dir)
             if self._lock is not None:
                 self._lock.acquire()
             try:
@@ -328,6 +333,14 @@ class CheckpointEngine:
         if loaded is None:
             return None
         mem_step, state, meta = loaded
+        if self._is_foreign_image(meta):
+            # Leftover segment from another job sharing the shm name
+            # (default JOB_NAME, reused dev box): not our checkpoint.
+            logger.warning(
+                "ignoring shm image of foreign checkpoint %s",
+                meta.get("ckpt_dir"),
+            )
+            return None
         if meta.get("num_processes") != self._ctx.num_processes:
             # World changed: per-process shm images do not cover the same
             # index set; storage has the complete picture.
@@ -348,10 +361,24 @@ class CheckpointEngine:
             return None
         return load_global_state(self.checkpoint_dir, target, metas)
 
+    def _is_foreign_image(self, meta: dict) -> bool:
+        stamped = meta.get("ckpt_dir")
+        return stamped is not None and stamped != os.path.realpath(
+            self.checkpoint_dir
+        )
+
     def latest_step(self) -> int:
-        """Newest restorable step (max of shm image and storage tracker)."""
+        """Newest restorable step (max of shm image and storage tracker).
+        A foreign job's shm image is not restorable by us and must not
+        be advertised."""
+        mem_step = -1
+        meta = self._shm.load_meta()
+        if meta is not None and not self._is_foreign_image(
+            meta.get("user_meta", {})
+        ):
+            mem_step = meta.get("step", -1)
         return max(
-            self._shm.get_step(),
+            mem_step,
             ckpt_storage.read_tracker(self.checkpoint_dir),
         )
 

@@ -88,6 +88,20 @@ def read_shm_payload(local_rank: int, lock=None):
             lock.release()
 
 
+def default_deletion_strategy(max_to_keep: int = 3):
+    """Retention policy for committed checkpoints. Env-selectable:
+    DLROVER_TPU_CKPT_KEEP_INTERVAL=N keeps every Nth step forever in
+    addition to the newest max_to_keep (sparse history for rollback)."""
+    from dlrover_tpu.common.env_utils import get_env_int
+
+    interval = get_env_int("DLROVER_TPU_CKPT_KEEP_INTERVAL", 0)
+    if interval > 0:
+        return ckpt_storage.KeepStepIntervalDeletionStrategy(
+            interval, max_to_keep
+        )
+    return ckpt_storage.KeepLatestDeletionStrategy(max_to_keep)
+
+
 def persist_shm_to_storage(
     checkpoint_dir: str,
     step: int,
@@ -98,6 +112,7 @@ def persist_shm_to_storage(
     commit_timeout: float = 600.0,
     max_to_keep: int = 3,
     locks: Optional[list] = None,
+    deletion_strategy=None,
 ) -> bool:
     """Persist this node's shm images for ``step`` and run the commit.
 
@@ -156,9 +171,10 @@ def persist_shm_to_storage(
         done = ckpt_storage.nodes_done(checkpoint_dir, step)
         if set(done) >= set(expected_nodes):
             ckpt_storage.write_tracker(checkpoint_dir, step)
-            ckpt_storage.KeepLatestDeletionStrategy(max_to_keep).clean_up(
-                checkpoint_dir
+            strategy = deletion_strategy or default_deletion_strategy(
+                max_to_keep
             )
+            strategy.clean_up(checkpoint_dir)
             if master_client is not None:
                 try:
                     master_client.report_ckpt_step(step, committed=True)

@@ -238,3 +238,36 @@ def test_keep_step_interval_deletion(tmp_path):
     )
     # Multiples of 20 survive (20, 40), plus the 2 newest (40, 45).
     assert kept == [20, 40, 45]
+
+
+def test_foreign_job_shm_image_rejected(tmp_path):
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    e1 = CheckpointEngine(str(tmp_path / "job_a"), standalone=True)
+    try:
+        e1.save_to_memory(9, {"w": jnp.ones((4,))})
+        # Same shm namespace, different checkpoint dir: must not restore.
+        e2 = CheckpointEngine(str(tmp_path / "job_b"), standalone=True)
+        assert e2.load() is None
+        # The rightful owner still restores.
+        step, _, _ = e1.load()
+        assert step == 9
+    finally:
+        e1._shm.unlink()
+        e1.close()
+
+
+def test_keep_interval_selected_by_env(monkeypatch):
+    from dlrover_tpu.flash_ckpt.saver import default_deletion_strategy
+    from dlrover_tpu.flash_ckpt.storage import (
+        KeepLatestDeletionStrategy,
+        KeepStepIntervalDeletionStrategy,
+    )
+
+    assert isinstance(
+        default_deletion_strategy(), KeepLatestDeletionStrategy
+    )
+    monkeypatch.setenv("DLROVER_TPU_CKPT_KEEP_INTERVAL", "500")
+    strategy = default_deletion_strategy()
+    assert isinstance(strategy, KeepStepIntervalDeletionStrategy)
+    assert strategy.keep_interval == 500
