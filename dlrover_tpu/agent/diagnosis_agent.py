@@ -12,7 +12,6 @@ short-circuit to relaunch early.
 import os
 import re
 import threading
-import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 

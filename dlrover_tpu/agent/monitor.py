@@ -9,7 +9,6 @@ than a NVML analogue.
 
 import os
 import threading
-import time
 from typing import Optional
 
 import psutil

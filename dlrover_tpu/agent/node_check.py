@@ -13,7 +13,7 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Optional, Tuple
+from typing import Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler

@@ -10,7 +10,6 @@ version changes.
 import json
 import os
 import threading
-import time
 from typing import Optional
 
 from dlrover_tpu.common.constants import NodeEnv

@@ -11,7 +11,7 @@ the world layout.
 
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import RendezvousName

@@ -33,14 +33,10 @@ from dlrover_tpu.agent.rendezvous import (
 )
 from dlrover_tpu.common.constants import (
     DiagnosisActionType,
-    ExitCode,
     GoodputPhase,
     JobConstant,
-    NodeEnv,
-    NodeEventType,
     RendezvousName,
     TrainingExceptionLevel,
-    WorkerEnv,
 )
 from dlrover_tpu.common.env_utils import worker_env
 from dlrover_tpu.common.log import logger

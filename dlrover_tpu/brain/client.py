@@ -9,7 +9,7 @@ plan when the brain is unreachable).
 
 import http.client
 import json
-from typing import Dict, Optional
+from typing import Dict
 
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import logger

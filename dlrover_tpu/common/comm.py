@@ -11,7 +11,7 @@ hint instead of a torch process-group world.
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from dlrover_tpu.common.serialize import PickleSerializable
 

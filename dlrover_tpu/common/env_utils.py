@@ -2,7 +2,6 @@
 
 import os
 import socket
-from typing import Optional
 
 from dlrover_tpu.common.constants import NodeEnv, WorkerEnv
 

@@ -8,7 +8,7 @@ process slot) inside a slice, or a CPU worker in local mode.
 import copy
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Optional
 
 from dlrover_tpu.common.constants import (
     NodeEventType,

@@ -11,8 +11,7 @@ classes to avoid arbitrary-code deserialization.
 import importlib
 import io
 import pickle
-from dataclasses import is_dataclass
-from typing import Any, Dict, Tuple, Type
+from typing import Any
 
 _ALLOWED_MODULE_PREFIXES = (
     "dlrover_tpu.",

@@ -9,7 +9,7 @@ servicer.py:_report_heartbeat, elastic_agent training.py:1489).
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict
+from typing import Dict
 
 from dlrover_tpu.common.constants import (
     DiagnosisActionType,

@@ -16,7 +16,6 @@ Usage:
 import os
 from typing import Any, Optional
 
-from dlrover_tpu.common.log import logger
 from dlrover_tpu.flash_ckpt.engine import CheckpointEngine, to_device_state
 from dlrover_tpu.flash_ckpt.shared_obj import socket_path
 

@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from dlrover_tpu.common.constants import GoodputPhase, NodeEnv
+from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.flash_ckpt import storage as ckpt_storage
 from dlrover_tpu.flash_ckpt.shared_obj import (

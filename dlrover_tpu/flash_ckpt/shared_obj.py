@@ -11,7 +11,6 @@ import os
 import pickle
 import queue as _queue
 import socket
-import socketserver
 import threading
 import time
 from typing import Any, Dict, Optional

@@ -16,7 +16,6 @@ Layout under ``checkpoint_dir``:
 import os
 import pickle
 import shutil
-import tempfile
 from abc import ABC, abstractmethod
 from typing import Dict, List, Optional
 

@@ -9,7 +9,7 @@ timeout; a parallel diagnose thread executes job-level DiagnosisActions
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Optional
 
 from dlrover_tpu.common.constants import (
     DiagnosisActionType,

@@ -7,7 +7,7 @@ for exit barriers and cross-host handshakes that must not ride collectives.
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 class KVStoreService:

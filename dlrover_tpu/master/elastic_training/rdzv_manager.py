@@ -17,8 +17,8 @@ import statistics
 import threading
 import time
 from abc import ABC, abstractmethod
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Set, Tuple
 
 from dlrover_tpu.common.constants import NetworkCheckConstant, RendezvousName
 from dlrover_tpu.common.log import logger

@@ -9,7 +9,6 @@ world, and the remat (activation checkpointing) policy when host OOMs
 are observed.
 """
 
-import math
 from typing import Dict, Optional
 
 from dlrover_tpu.common import comm

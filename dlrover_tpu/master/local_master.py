@@ -8,9 +8,8 @@ cluster.
 
 import threading
 import time
-from typing import Optional
 
-from dlrover_tpu.common.constants import JobConstant, JobStage
+from dlrover_tpu.common.constants import JobConstant
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.elastic_training.rdzv_manager import (
     create_rdzv_managers,

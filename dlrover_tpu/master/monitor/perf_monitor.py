@@ -13,7 +13,6 @@ from collections import defaultdict, deque
 from typing import Deque, Dict, Optional, Tuple
 
 from dlrover_tpu.common.constants import GoodputPhase
-from dlrover_tpu.common.log import logger
 
 
 class PerfMonitor:

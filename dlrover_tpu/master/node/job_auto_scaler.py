@@ -10,7 +10,6 @@ membership-change path.
 """
 
 import threading
-import time
 
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import logger

@@ -11,7 +11,6 @@ from typing import List, Optional
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import (
     JobStage,
-    NodeEventType,
     NodeStatus,
     NodeType,
     TrainingExceptionLevel,

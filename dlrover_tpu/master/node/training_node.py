@@ -13,7 +13,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import NodeStatus, NodeType
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.common.node import Node, NodeGroupResource
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan
 
 

@@ -13,7 +13,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List
 
-from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.common.node import Node, NodeGroupResource
 
 
 @dataclass

@@ -11,11 +11,7 @@ from typing import Dict, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.comm import Message
-from dlrover_tpu.common.constants import (
-    NodeType,
-    PreCheckStatus,
-    RendezvousName,
-)
+from dlrover_tpu.common.constants import PreCheckStatus, RendezvousName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.elastic_training.elastic_ps import ClusterVersionService
 from dlrover_tpu.master.elastic_training.kv_store import KVStoreService

@@ -8,7 +8,7 @@ checkpoint/restore so a restarted job resumes exactly the unconsumed data.
 import json
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common import comm

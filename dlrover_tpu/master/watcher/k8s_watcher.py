@@ -11,7 +11,6 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
     ExitCode,
-    NodeEventType,
     NodeExitReason,
     NodeStatus,
     NodeType,

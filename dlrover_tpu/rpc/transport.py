@@ -13,7 +13,6 @@ servicer.py:994 HttpMasterServicer).
 
 import abc
 import http.client
-import json
 import threading
 import time
 from concurrent import futures

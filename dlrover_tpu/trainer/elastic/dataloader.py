@@ -6,8 +6,7 @@ stacked numpy batches selected by an ElasticDistributedSampler (static
 split) or an IndexShardingClient (master-driven dynamic shards).
 """
 
-import math
-from typing import Callable, Iterator, Optional
+from typing import Callable, Iterator
 
 import numpy as np
 

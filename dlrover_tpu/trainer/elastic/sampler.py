@@ -8,7 +8,7 @@ revisiting consumed records: completed count is recorded globally and the
 remaining indices are re-dealt over the *new* world size.
 """
 
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator
 
 import numpy as np
 

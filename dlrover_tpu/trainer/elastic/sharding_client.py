@@ -14,7 +14,6 @@ from typing import Iterator, List, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import TaskType
-from dlrover_tpu.common.log import logger
 
 
 class ShardingClient:

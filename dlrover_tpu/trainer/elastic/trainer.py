@@ -11,7 +11,6 @@ schedule surgery.
 
 import time
 from dataclasses import dataclass
-from typing import Optional
 
 from dlrover_tpu.common.log import logger
 

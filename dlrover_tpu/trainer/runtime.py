@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from dlrover_tpu.common.constants import NodeEnv, WorkerEnv
+from dlrover_tpu.common.constants import WorkerEnv
 from dlrover_tpu.common.log import logger
 
 

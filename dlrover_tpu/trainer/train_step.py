@@ -12,8 +12,7 @@ reference's ``ElasticTrainer`` fixed-batch grad-accum
 """
 
 import dataclasses
-from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +20,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.models import llama
-from dlrover_tpu.parallel.mesh import BATCH_AXES
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
     logical_to_spec,

@@ -14,7 +14,6 @@ import os
 import queue
 import threading
 import time
-from typing import Optional
 
 from dlrover_tpu.common.log import logger
 

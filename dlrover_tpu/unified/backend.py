@@ -13,7 +13,7 @@ import signal
 import subprocess
 import sys
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Optional
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.unified.config import RoleConfig

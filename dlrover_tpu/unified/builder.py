@@ -16,7 +16,7 @@ Example::
     )
 """
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from dlrover_tpu.unified.config import DLJobConfig, RoleConfig
 

@@ -10,7 +10,7 @@ group index land in the same placement bundle (the STRICT_PACK analogue
 from dataclasses import dataclass, field
 from typing import Dict, List
 
-from dlrover_tpu.unified.config import DLJobConfig, RoleConfig
+from dlrover_tpu.unified.config import DLJobConfig
 
 
 @dataclass

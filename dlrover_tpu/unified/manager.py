@@ -8,7 +8,7 @@ MasterStateBackend for master self-failover).
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.unified.backend import Backend, LocalProcessBackend, WorkerHandle
