@@ -161,6 +161,7 @@ class ElasticAgent:
                     local_world_size=spec.nproc_per_node,
                     restart_count=self._restart_count,
                     rdzv_round=outcome.round,
+                    node_ranks=list(outcome.world),
                 )
             )
             if spec.entrypoint.startswith("-m "):

@@ -163,6 +163,9 @@ class WorkerEnv:
     LOCAL_WORLD_SIZE = "DLROVER_TPU_LOCAL_WORLD_SIZE"
     RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
     RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
+    # Comma-separated node ranks of the current world (commit protocol
+    # needs the ACTUAL membership, not arithmetic over process counts).
+    NODE_RANKS = "DLROVER_TPU_NODE_RANKS"
 
 
 class JobConstant:

@@ -73,9 +73,10 @@ def worker_env(
     local_world_size: int = 1,
     restart_count: int = 0,
     rdzv_round: int = 0,
+    node_ranks=None,
 ) -> dict:
     """Build the env block the agent injects into each JAX worker."""
-    return {
+    env = {
         WorkerEnv.COORDINATOR_ADDRESS: coordinator,
         WorkerEnv.NUM_PROCESSES: str(num_processes),
         WorkerEnv.PROCESS_ID: str(process_id),
@@ -84,3 +85,6 @@ def worker_env(
         WorkerEnv.RESTART_COUNT: str(restart_count),
         WorkerEnv.RDZV_ROUND: str(rdzv_round),
     }
+    if node_ranks:
+        env[WorkerEnv.NODE_RANKS] = ",".join(str(r) for r in node_ranks)
+    return env

@@ -280,7 +280,12 @@ class CheckpointEngine:
         elapsed = self.save_to_memory(step, state, user_meta)
         self._last_disk_step = step
         if self._standalone:
-            self._persist_in_process(step)
+            # Mirror the agent path: one persister per node. Every local
+            # worker writing the node's files concurrently would race on
+            # the shared tmp names and multiply checkpoint I/O by the
+            # local world size.
+            if self._local_rank == 0:
+                self._persist_in_process(step)
         elif self._local_rank == 0:
             self._event_queue.put(
                 SaveEvent(
@@ -296,12 +301,23 @@ class CheckpointEngine:
         from dlrover_tpu.flash_ckpt.saver import persist_shm_to_storage
 
         node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        # Expect every node of the world: only the leader (lowest rank)
+        # commits, and only after all nodes' shard markers exist — each
+        # node committing alone would advance the tracker to steps whose
+        # peer shards aren't on disk yet (unrestorable "latest" step).
+        # The agent injects the ACTUAL membership; arithmetic over
+        # process counts would be wrong for uneven or non-contiguous
+        # worlds.
+        expected = list(self._ctx.node_ranks) or [node_rank]
         persist_shm_to_storage(
             self.checkpoint_dir,
             step,
             node_rank,
             local_world_size=self._ctx.local_world_size,
-            expected_nodes=[node_rank],
+            expected_nodes=expected,
+            # Standalone runs the commit on the TRAINING thread: a dead
+            # peer must cost seconds, not the agent path's 10 minutes.
+            commit_timeout=30.0,
         )
 
     # ---- load --------------------------------------------------------------

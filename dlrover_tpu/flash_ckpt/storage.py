@@ -104,12 +104,22 @@ def read_tracker(checkpoint_dir: str) -> int:
 
 def write_tracker(checkpoint_dir: str, step: int):
     os.makedirs(checkpoint_dir, exist_ok=True)
-    tmp = tracker_path(checkpoint_dir) + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(str(step))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, tracker_path(checkpoint_dir))
+    # Per-process tmp name: concurrent committers (multi-node standalone)
+    # must not os.replace each other's tmp files out from under them.
+    tmp = tracker_path(checkpoint_dir) + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, tracker_path(checkpoint_dir))
+    except OSError:
+        # Unique names never self-overwrite: reclaim the orphan.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def persist_node_shards(

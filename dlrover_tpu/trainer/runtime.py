@@ -28,6 +28,7 @@ class DistributedContext:
     local_world_size: int
     restart_count: int
     rdzv_round: int
+    node_ranks: tuple = ()
     initialized_jax_distributed: bool = False
 
     @property
@@ -47,6 +48,11 @@ def read_worker_env() -> DistributedContext:
         local_world_size=int(os.getenv(WorkerEnv.LOCAL_WORLD_SIZE, "1")),
         restart_count=int(os.getenv(WorkerEnv.RESTART_COUNT, "0")),
         rdzv_round=int(os.getenv(WorkerEnv.RDZV_ROUND, "0")),
+        node_ranks=tuple(
+            int(r)
+            for r in os.getenv(WorkerEnv.NODE_RANKS, "").split(",")
+            if r.strip()
+        ),
     )
 
 
