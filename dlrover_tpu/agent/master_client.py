@@ -106,7 +106,8 @@ class MasterClient:
         resp = self._get(
             comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
         )
-        return resp.round, resp.group, resp.world
+        rank_order = getattr(resp, "rank_order", None) or list(resp.world)
+        return resp.round, resp.group, resp.world, rank_order
 
     @retry_rpc
     def num_nodes_waiting(self, rdzv_name: str) -> int:

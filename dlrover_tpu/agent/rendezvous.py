@@ -75,11 +75,14 @@ class MasterRendezvousHandler:
         )
         deadline = time.time() + self._join_timeout
         world: Dict[int, int] = {}
+        rank_order: list = []
         rdzv_round = 0
         group = 0
         while time.time() < deadline:
-            rdzv_round, group, world = self._client.get_comm_world(
-                self._rdzv_name, self._node_rank
+            rdzv_round, group, world, rank_order = (
+                self._client.get_comm_world(
+                    self._rdzv_name, self._node_rank
+                )
             )
             if world:
                 if self._node_rank in world:
@@ -99,9 +102,15 @@ class MasterRendezvousHandler:
 
         # The master chooses the world ORDER (possibly topology-aware:
         # slice-mates adjacent, DCN hops only at block boundaries) and
-        # the dict preserves it over the wire; global process ids follow
-        # that order, not numeric node rank.
-        ranks = list(world)
+        # sends it as an EXPLICIT rank list; global process ids follow
+        # that order, not numeric node rank. Relying on the world dict's
+        # insertion order surviving the transport would be fragile.
+        ranks = rank_order if rank_order else list(world)
+        if set(ranks) != set(world):
+            raise RuntimeError(
+                f"rank_order {ranks} disagrees with world {sorted(world)}; "
+                "master/agent protocol mismatch"
+            )
         num_processes = sum(world.values())
         my_pos = ranks.index(self._node_rank)
         process_id_base = sum(world[r] for r in ranks[:my_pos])

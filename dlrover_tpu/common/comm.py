@@ -76,6 +76,12 @@ class CommWorld(BaseResponse):
     group: int = 0
     world: Dict[int, int] = field(default_factory=dict)
     coordinator_rank: int = -1  # node chosen to host the JAX coordinator
+    # Explicit rank ordering (master's topology-aware choice). Process-id
+    # assignment MUST follow this list, not the world dict's insertion
+    # order — dict order surviving the transport is an artifact of the
+    # pickle wire format, and a future proto/JSON transport would
+    # silently desynchronize ranks across nodes without this field.
+    rank_order: List[int] = field(default_factory=list)
 
 
 @dataclass

@@ -190,6 +190,9 @@ class CheckpointConstant:
     STAGE_DIR = "._dlrover_ckpt_stage"
     MODEL_STATES_NAME = "model_states"
     SAVE_TIMEOUT = 600
+    # KV-store key under which the master publishes the per-job replica
+    # auth token (seeded in servicer, consumed by flash_ckpt/replica.py).
+    REPLICA_TOKEN_KEY = "ckpt-replica/token"
 
 
 class NetworkCheckConstant:

@@ -15,23 +15,82 @@ from typing import Any
 
 _ALLOWED_MODULE_PREFIXES = (
     "dlrover_tpu.",
-    "builtins",
     "collections",
     "numpy",
     "datetime",
 )
 
+# ``builtins`` must NOT be allowed wholesale: builtins.eval/exec/getattr are
+# classic pickle RCE gadgets. Only value constructors that real messages use.
+_ALLOWED_BUILTINS = frozenset(
+    {
+        "bool", "int", "float", "complex", "str", "bytes", "bytearray",
+        "list", "tuple", "dict", "set", "frozenset", "slice", "range",
+        "object", "NoneType", "Exception",
+    }
+)
+
+# Extra names needed to unpickle a jax pytree structure — used by the
+# flash-checkpoint shm/storage metadata loader, never by the control-plane
+# RPC path. PyTreeDef's reducer references the jaxlib extension class and
+# the default registry; the exact module path moved across jaxlib
+# versions, so match by name under jax/jaxlib prefixes.
+_PYTREE_NAMES = frozenset({"PyTreeDef", "default_registry", "pytree"})
+
+# Module prefixes whose *classes* may appear as custom pytree node types
+# in a real training state: optimizer states are optax NamedTuples, train
+# states are flax struct dataclasses, etc. Users register their own node
+# modules via DLROVER_TPU_PYTREE_MODULES (comma-separated prefixes).
+_PYTREE_NODE_PREFIXES = (
+    "jax",
+    "jaxlib",
+    "optax",
+    "flax",
+    "chex",
+    "haiku",
+    "ml_dtypes",
+)
+
+
+def _extra_pytree_prefixes():
+    import os
+
+    raw = os.getenv("DLROVER_TPU_PYTREE_MODULES", "")
+    return tuple(p.strip() for p in raw.split(",") if p.strip())
+
 
 class _RestrictedUnpickler(pickle.Unpickler):
+    allow_pytree = False
+
     def find_class(self, module: str, name: str):
-        if module == "builtins" or any(
+        if module == "builtins":
+            if name in _ALLOWED_BUILTINS:
+                return getattr(importlib.import_module(module), name)
+        elif any(
             module == p.rstrip(".") or module.startswith(p)
             for p in _ALLOWED_MODULE_PREFIXES
         ):
             return getattr(importlib.import_module(module), name)
+        elif self.allow_pytree:
+            root = module.split(".", 1)[0]
+            if root in _PYTREE_NODE_PREFIXES or any(
+                module == p or module.startswith(p + ".") or root == p
+                for p in _extra_pytree_prefixes()
+            ):
+                obj = getattr(importlib.import_module(module), name)
+                # Admit classes (pytree node types: NamedTuples, struct
+                # dataclasses) and the known jax registry singletons, but
+                # never plain functions — REDUCE on an arbitrary callable
+                # is the code-execution gadget this loader exists to block.
+                if isinstance(obj, type) or name in _PYTREE_NAMES:
+                    return obj
         raise pickle.UnpicklingError(
             f"blocked unpickle of {module}.{name}: not a control-plane type"
         )
+
+
+class _PytreeUnpickler(_RestrictedUnpickler):
+    allow_pytree = True
 
 
 def dumps(obj: Any) -> bytes:
@@ -40,6 +99,16 @@ def dumps(obj: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def loads_pytree(data: bytes) -> Any:
+    """Restricted unpickle that additionally admits jax PyTreeDef.
+
+    For checkpoint metadata (shm images, storage shard meta) which embeds
+    pickled tree structures; everything else stays locked down, so a
+    hostile payload reaching a checkpoint port cannot execute code.
+    """
+    return _PytreeUnpickler(io.BytesIO(data)).load()
 
 
 class PickleSerializable:

@@ -278,14 +278,23 @@ class CheckpointEngine:
     ) -> float:
         """Save to shm, then request async persistence to storage."""
         elapsed = self.save_to_memory(step, state, user_meta)
+        prev_disk_step = self._last_disk_step
         self._last_disk_step = step
         if self._standalone:
             # Mirror the agent path: one persister per node. Every local
             # worker writing the node's files concurrently would race on
             # the shared tmp names and multiply checkpoint I/O by the
             # local world size.
-            if self._local_rank == 0:
-                self._persist_in_process(step)
+            if self._local_rank == 0 and not self._persist_in_process(step):
+                logger.error(
+                    "standalone persist of step %d failed; the disk "
+                    "checkpoint for this step was NOT committed",
+                    step,
+                )
+                # This process KNOWS the step never committed: leaving it
+                # recorded would make wait_saving_complete block its full
+                # timeout on a tracker that will never advance.
+                self._last_disk_step = prev_disk_step
         elif self._local_rank == 0:
             self._event_queue.put(
                 SaveEvent(
@@ -297,10 +306,23 @@ class CheckpointEngine:
             )
         return elapsed
 
-    def _persist_in_process(self, step: int):
+    def _persist_in_process(self, step: int) -> bool:
         from dlrover_tpu.flash_ckpt.saver import persist_shm_to_storage
 
         node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        # Standalone has no shm locks and no agent: sibling local workers
+        # write their segments on their own schedule, so wait (bounded)
+        # until every local segment holds >= the requested step before
+        # reading — otherwise the persist sees a missing/older sibling
+        # image and the step's disk checkpoint is silently dropped.
+        if not self._wait_local_segments(step, timeout=30.0):
+            logger.error(
+                "not all %d local shm segments reached step %d within "
+                "30s; aborting standalone persist",
+                self._ctx.local_world_size,
+                step,
+            )
+            return False
         # Expect every node of the world: only the leader (lowest rank)
         # commits, and only after all nodes' shard markers exist — each
         # node committing alone would advance the tracker to steps whose
@@ -309,7 +331,7 @@ class CheckpointEngine:
         # process counts would be wrong for uneven or non-contiguous
         # worlds.
         expected = list(self._ctx.node_ranks) or [node_rank]
-        persist_shm_to_storage(
+        return persist_shm_to_storage(
             self.checkpoint_dir,
             step,
             node_rank,
@@ -319,6 +341,26 @@ class CheckpointEngine:
             # peer must cost seconds, not the agent path's 10 minutes.
             commit_timeout=30.0,
         )
+
+    def _wait_local_segments(self, step: int, timeout: float) -> bool:
+        """True once every local worker's shm segment holds >= ``step``."""
+        deadline = time.time() + timeout
+        while True:
+            ready = True
+            for lr in range(self._ctx.local_world_size):
+                if lr == self._local_rank:
+                    continue  # our own save already landed
+                handler = SharedMemoryHandler(shm_segment_name(lr))
+                sibling_step = handler.get_step()
+                handler.close()
+                if sibling_step < step:
+                    ready = False
+                    break
+            if ready:
+                return True
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.05)
 
     # ---- load --------------------------------------------------------------
 
@@ -472,14 +514,13 @@ def _assemble_from_shards(global_shape, dtype_name, shards):
 
 def load_global_state(checkpoint_dir: str, step: int, metas: Dict[int, dict]):
     """Assemble the full global state from every process's shard files."""
-    import pickle
-
     import jax
 
+    from dlrover_tpu.common.serialize import loads_pytree
     from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
 
     first = metas[min(metas)]
-    treedef = pickle.loads(first["treedef"])
+    treedef = loads_pytree(first["treedef"])
     num_leaves = len(first["leaves"])
     leaves = [None] * num_leaves
     user_meta = first.get("user_meta", {})
@@ -523,11 +564,18 @@ def to_device_state(np_state, sharding_tree=None):
         return jax.tree_util.tree_map(jax.numpy.asarray, np_state)
 
     try:
+        from jax.errors import JaxRuntimeError as _XlaRuntimeError
+    except ImportError:  # older jaxlib spelling
+        from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+
+    try:
         return jax.device_put(np_state, sharding_tree)
-    except Exception as e:  # runtimes reject this in varied ways
-        # (XlaRuntimeError, NotImplementedError, ValueError ...); any of
-        # them means "use the per-leaf addressable-shard path".
-        logger.info(
+    except (ValueError, NotImplementedError, _XlaRuntimeError) as e:
+        # The known "runtime rejects global host arrays under
+        # non-addressable shardings" shapes only — anything else (host
+        # OOM, dtype corruption) must surface, not be absorbed by the
+        # slower per-leaf fallback.
+        logger.warning(
             "batched device_put restore unavailable (%s: %s); using "
             "per-leaf transfers",
             type(e).__name__,

@@ -19,14 +19,14 @@ restored segment is byte-identical to what the lost node held and the
 normal memory-first engine load path just works.
 """
 
-import hashlib
 import http.client
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.flash_ckpt.engine import shm_segment_name
 from dlrover_tpu.flash_ckpt.shm_handler import (
@@ -35,25 +35,41 @@ from dlrover_tpu.flash_ckpt.shm_handler import (
 )
 
 _ADDR_KEY = "ckpt-replica-addr/{rank}"
+REPLICA_TOKEN_KEY = CheckpointConstant.REPLICA_TOKEN_KEY
 
 
-def _auth_token() -> str:
+class ReplicaTokenUnavailable(RuntimeError):
+    """No usable shared secret for the replica service."""
+
+
+def resolve_auth_token(master_client=None, timeout: float = 30.0) -> str:
     """Shared-secret header value for the replica service.
 
-    Replica payloads are pickled on load, so writes must be limited to
-    job members. Operators should set DLROVER_TPU_REPLICA_TOKEN to a real
-    secret; the fallback (job name + master addr digest) at least blocks
-    cross-job and casual access on a shared network.
+    Replica payloads end up in workers' shm segments, so writes must be
+    limited to job members. The secret is either the operator-provided
+    DLROVER_TPU_REPLICA_TOKEN (the strong option: never on the wire via
+    the master) or the random per-job token the master generates at
+    startup and serves via its KV store — not derivable offline, though
+    readable by anyone who can already reach the master's RPC port.
+    Without either, the service refuses to start.
     """
     token = os.getenv("DLROVER_TPU_REPLICA_TOKEN", "")
     if token:
         return token
-    seed = (
-        os.getenv(NodeEnv.JOB_NAME, "job")
-        + "|"
-        + os.getenv(NodeEnv.MASTER_ADDR, "")
+    if master_client is not None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                value = master_client.kv_store_get(REPLICA_TOKEN_KEY)
+            except Exception:
+                value = b""
+            if value:
+                return value.decode()
+            time.sleep(0.5)
+    raise ReplicaTokenUnavailable(
+        "checkpoint replica service needs DLROVER_TPU_REPLICA_TOKEN or a "
+        "master-distributed per-job token; refusing to open the port"
     )
-    return hashlib.sha256(seed.encode()).hexdigest()[:32]
 
 
 # ---------------------------------------------------------------------------
@@ -190,7 +206,7 @@ class CkptReplicaManager:
         self._client = master_client
         self._group_size = max(1, group_size)
         self._store = _ReplicaStore()
-        self._token = _auth_token()
+        self._token = resolve_auth_token(master_client)
         self._server = ThreadingHTTPServer(
             ("0.0.0.0", port), _make_handler(self._store, self._token)
         )

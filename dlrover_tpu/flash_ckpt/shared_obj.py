@@ -46,7 +46,11 @@ def _recv_msg(conn: socket.socket) -> Optional[dict]:
             return None
         chunks.append(chunk)
         size -= len(chunk)
-    return pickle.loads(b"".join(chunks))
+    # UDS sockets are filesystem-permission scoped, but keep the same
+    # no-arbitrary-code deserialization policy as every other boundary.
+    from dlrover_tpu.common.serialize import loads_pytree
+
+    return loads_pytree(b"".join(chunks))
 
 
 def _send_msg(conn: socket.socket, obj: Any):

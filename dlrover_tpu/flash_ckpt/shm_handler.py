@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.serialize import loads_pytree
 
 MAGIC = b"DLRTPUC1"
 _ALIGN = 128
@@ -258,7 +259,9 @@ class SharedMemoryHandler:
         if bytes(buf[:8]) != MAGIC:
             return None
         meta_len = int.from_bytes(bytes(buf[8:16]), "big")
-        return pickle.loads(bytes(buf[16 : 16 + meta_len]))
+        # Restricted unpickle: shm bytes can arrive over the replica
+        # service, so metadata must never be a code-execution vector.
+        return loads_pytree(bytes(buf[16 : 16 + meta_len]))
 
     def load_state_dict(self) -> Optional[Tuple[int, Any, dict]]:
         """Return (step, pytree-of-numpy, user_meta); leaves are copies.
@@ -273,7 +276,7 @@ class SharedMemoryHandler:
 
         buf = self._shm.buf
         data_start = meta["data_start"]
-        treedef = pickle.loads(meta["treedef"])
+        treedef = loads_pytree(meta["treedef"])
         leaves = []
         for leaf_meta in meta["leaves"]:
             dtype = _np_dtype(leaf_meta.dtype)

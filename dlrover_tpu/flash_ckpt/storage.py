@@ -173,6 +173,9 @@ def nodes_done(checkpoint_dir: str, step: int) -> List[int]:
 
 def load_step_meta(checkpoint_dir: str, step: int) -> Dict[int, dict]:
     """process_id -> meta for every proc file present."""
+    # Restricted unpickle: checkpoint dirs may live on shared storage.
+    from dlrover_tpu.common.serialize import loads_pytree
+
     sdir = step_dir(checkpoint_dir, step)
     metas: Dict[int, dict] = {}
     if not os.path.isdir(sdir):
@@ -181,7 +184,7 @@ def load_step_meta(checkpoint_dir: str, step: int) -> Dict[int, dict]:
         if name.startswith("proc-") and name.endswith(".meta"):
             pid = int(name[5:-5])
             with open(os.path.join(sdir, name), "rb") as f:
-                metas[pid] = pickle.load(f)
+                metas[pid] = loads_pytree(f.read())
     return metas
 
 

@@ -43,6 +43,20 @@ class MasterServicer(MasterService):
         self._perf_monitor = perf_monitor
         self._sync_service = sync_service or SyncService()
         self._kv_store = kv_store or KVStoreService()
+        # Per-job random secret for the agents' checkpoint-replica HTTP
+        # exchange (flash_ckpt/replica.py): not derivable from job
+        # metadata, though anyone who can reach the master's (itself
+        # unauthenticated) KV RPC can still read it — operators wanting a
+        # secret outside that trust domain set DLROVER_TPU_REPLICA_TOKEN.
+        from dlrover_tpu.common.constants import CheckpointConstant
+
+        if not self._kv_store.get(CheckpointConstant.REPLICA_TOKEN_KEY):
+            import secrets
+
+            self._kv_store.set(
+                CheckpointConstant.REPLICA_TOKEN_KEY,
+                secrets.token_hex(16).encode(),
+            )
         self._job_metric_collector = job_metric_collector
         self._elastic_ps_service = elastic_ps_service or ClusterVersionService()
         self._pre_check_status = PreCheckStatus.PASS
@@ -149,12 +163,13 @@ class MasterServicer(MasterService):
         if mgr is None:
             return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
         rdzv_round, group, world = mgr.get_comm_world(req.node_id)
-        coordinator_rank = min(world) if world else -1
+        rank_order = list(world)
         return comm.CommWorld(
             round=rdzv_round,
             group=group,
             world=world,
-            coordinator_rank=coordinator_rank,
+            coordinator_rank=rank_order[0] if rank_order else -1,
+            rank_order=rank_order,
         )
 
     def _num_nodes_waiting(self, msg, req: comm.NumNodesWaitingRequest):

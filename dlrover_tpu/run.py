@@ -248,15 +248,25 @@ def run(args) -> int:
 
     replica_manager = None
     if args.ckpt_replica_group > 1:
-        from dlrover_tpu.flash_ckpt.replica import CkptReplicaManager
+        from dlrover_tpu.flash_ckpt.replica import (
+            CkptReplicaManager,
+            ReplicaTokenUnavailable,
+        )
 
         from dlrover_tpu.common.env_utils import get_hostname_ip
 
-        replica_manager = CkptReplicaManager(
-            node_rank=node_rank,
-            master_client=client,
-            group_size=args.ckpt_replica_group,
-        )
+        try:
+            replica_manager = CkptReplicaManager(
+                node_rank=node_rank,
+                master_client=client,
+                group_size=args.ckpt_replica_group,
+            )
+        except ReplicaTokenUnavailable:
+            logger.error(
+                "no replica auth token available; running WITHOUT "
+                "cross-host checkpoint replicas"
+            )
+    if replica_manager is not None:
         # Publish a routable address, not loopback: peers resolve it from
         # the master KV store.
         replica_manager.start(advertise_host=get_hostname_ip()[1])

@@ -7,10 +7,39 @@ import pytest
 from dlrover_tpu.flash_ckpt.engine import shm_segment_name
 from dlrover_tpu.flash_ckpt.replica import (
     CkptReplicaManager,
+    ReplicaTokenUnavailable,
     restore_segment,
     snapshot_segment,
 )
 from dlrover_tpu.flash_ckpt.shm_handler import SharedMemoryHandler
+
+
+@pytest.fixture(autouse=True)
+def replica_token(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_REPLICA_TOKEN", "test-secret")
+
+
+def test_refuses_to_start_without_token(monkeypatch):
+    monkeypatch.delenv("DLROVER_TPU_REPLICA_TOKEN", raising=False)
+    with pytest.raises(ReplicaTokenUnavailable):
+        CkptReplicaManager(node_rank=0, group_size=2)
+
+
+def test_token_fetched_from_master_kv(monkeypatch):
+    class FakeClient:
+        def kv_store_get(self, key):
+            assert key == "ckpt-replica/token"
+            return b"master-random-token"
+
+        def kv_store_set(self, key, value):
+            pass
+
+    monkeypatch.delenv("DLROVER_TPU_REPLICA_TOKEN", raising=False)
+    m = CkptReplicaManager(node_rank=0, master_client=FakeClient())
+    try:
+        assert m._token == "master-random-token"
+    finally:
+        m.stop()
 
 
 @pytest.fixture

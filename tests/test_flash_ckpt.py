@@ -69,6 +69,58 @@ def test_memory_save_load_roundtrip(tmp_path):
     _cleanup(ckpt)
 
 
+def test_optax_state_roundtrip(tmp_path):
+    """Custom pytree node types (optax NamedTuple optimizer states) must
+    survive the restricted-unpickle restore path — a policy that only
+    admits plain containers would make every real checkpoint
+    save-but-never-restore."""
+    import optax
+
+    params = {"w": jnp.ones((8, 4)), "b": jnp.zeros((4,))}
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-3))
+    state = {"params": params, "opt_state": tx.init(params), "step": 11}
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), standalone=True)
+    ckpt.save_checkpoint(11, state, storage_type=StorageType.DISK)
+    # memory restore
+    step, restored, _ = ckpt.load_checkpoint()
+    assert step == 11
+    chex_leaves = jax.tree_util.tree_leaves(restored["opt_state"])
+    assert len(chex_leaves) == len(
+        jax.tree_util.tree_leaves(state["opt_state"])
+    )
+    # storage restore (forces the on-disk meta/treedef path)
+    ckpt._engine._shm.unlink()
+    ckpt._engine._shm.close()
+    step2, restored2, _ = ckpt.load_checkpoint()
+    assert step2 == 11
+    assert type(restored2["opt_state"]) is type(state["opt_state"])
+    ckpt.close()
+
+
+def test_restricted_unpickler_blocks_gadgets():
+    import pickle
+
+    from dlrover_tpu.common.serialize import loads, loads_pytree
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("1+1",))
+
+    payload = pickle.dumps(Evil())
+    for loader in (loads, loads_pytree):
+        with pytest.raises(pickle.UnpicklingError):
+            loader(payload)
+
+    class EvilFnUnderAllowedPrefix:
+        def __reduce__(self):
+            import optax
+
+            return (optax.adamw, (1e-3,))
+
+    with pytest.raises(pickle.UnpicklingError):
+        loads_pytree(pickle.dumps(EvilFnUnderAllowedPrefix()))
+
+
 def test_disk_save_and_commit(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     ckpt = Checkpointer(ckpt_dir, standalone=True)
