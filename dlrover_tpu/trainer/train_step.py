@@ -139,8 +139,18 @@ def make_train_step(
     dim is split into ``grad_accum`` scan iterations; gradients average in
     f32.
     """
+    attention_fn = None
+    if dict(mesh.shape).get("sp", 1) > 1:
+        # Sequence-parallel mesh: attention must hop K/V around the sp
+        # ring (plain attention over a seq-sharded constraint would make
+        # XLA all-gather the full sequence on every layer).
+        from dlrover_tpu.ops.ring_attention import make_ring_attention
+
+        attention_fn = make_ring_attention(mesh, rules)
     _loss = loss_fn or (
-        lambda params, batch: llama.loss_fn(config, params, batch)
+        lambda params, batch: llama.loss_fn(
+            config, params, batch, attention_fn=attention_fn
+        )
     )
     specs = state_specs(config, optimizer, rules)
     shardings = state_shardings(specs, mesh)
