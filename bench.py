@@ -1,19 +1,26 @@
-"""North-star benchmark: goodput under injected preemption.
+"""North-star benchmark: goodput under injected preemption + compute MFU.
 
-Trains a GPT-style TpuLM on the available accelerator with flash
-checkpointing to host shared memory, then injects a REAL preemption:
-the device state is discarded (exactly what a worker kill does to HBM),
-restored from the in-memory checkpoint, and the lost steps are replayed.
+Three phases, one JSON line:
 
-Every component is measured on hardware: clean step time, checkpoint
-save block time, restore time, replay time. The headline goodput is
-computed from those measurements at the reference's operating point
-(one failure per hour at scale, checkpoint every 60s) — the same basis
-as DLRover's 69% -> 95% goodput claim (README.md:61-63,
-docs/blogs/flash_checkpoint.md:400-409). The compressed-timeline raw
-goodput of this short run is also reported (``raw_run_goodput``).
+1. **Compute** — trains the largest flagship TpuLM the chip holds
+   (~330M params, head_dim 128, bf16) WITHOUT checkpointing and reports
+   measured MFU against the device's peak (TPU v5e: 197 bf16 TFLOP/s).
+   The model path runs the Pallas flash-attention kernel (fwd + fused
+   bwd) selected by ``models/llama.default_attention_fn``.
+2. **Attention A/B** — pallas-vs-XLA attention fwd+bwd on the flagship
+   head shape at two sequence lengths, timed on hardware with a
+   carry-chained in-jit scan (the tunnel's ~100ms RTT and unreliable
+   ``block_until_ready`` make naive timing meaningless; a host fetch is
+   the only real barrier).
+3. **Goodput** — trains a checkpoint-sized TpuLM with flash
+   checkpointing to host shm, injects a REAL preemption (device state
+   discarded, restored from the in-memory checkpoint, lost steps
+   replayed), and reports goodput at the reference's operating point
+   (one failure/hour, save every 60s — the basis of DLRover's 69%→95%
+   claim, README.md:61-63) plus the raw measured numbers.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Env: BENCH_FAST=1 skips phases 1-2 (quick smoke). BENCH_CKPT_DIR sets
+the goodput phase's storage dir.
 """
 
 import json
@@ -24,12 +31,30 @@ BASELINE_GOODPUT = 95.0  # reference claim, README.md:61-63
 MTBF_S = 3600.0          # assumed failure interval at scale (1/h)
 SAVE_EVERY_S = 60.0      # flash-ckpt cadence at the operating point
 
+# bf16 peak FLOP/s by device kind (prefix match).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # trillium
+}
+
+
+def device_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return PEAK_FLOPS[prefix]
+    return 197e12
+
 
 def probe_d2h_bandwidth_mbs() -> float:
     """Measured device->host MB/s: flash-ckpt save cost is dominated by
     this, and it varies ~1000x between a local PCIe TPU and a tunneled
-    dev chip. The bench sizes its model so one state transfer stays
-    bounded regardless."""
+    dev chip. The bench sizes the goodput model so one state transfer
+    stays bounded regardless."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -41,7 +66,163 @@ def probe_d2h_bandwidth_mbs() -> float:
     return 8.0 / max(time.time() - t0, 1e-6)
 
 
-def build(platform: str):
+# ---------------------------------------------------------------------------
+# Phase 1: compute MFU
+# ---------------------------------------------------------------------------
+
+
+def compute_phase():
+    """Train a ~330M-param model (no ckpt), return MFU facts."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer import train_step as ts
+
+    cfg = llama.TpuLMConfig(
+        vocab_size=32000,
+        embed_dim=1024,
+        n_layers=16,
+        n_heads=8,
+        n_kv_heads=8,
+        head_dim=128,
+        mlp_dim=4096,
+        dtype="bfloat16",
+    )
+    batch, seq, steps = 8, 2048, 12
+    mesh = build_mesh(MeshConfig(dp=len(jax.devices())), jax.devices())
+    tc = ts.TrainConfig(warmup_steps=10)
+    opt = ts.make_optimizer(tc)
+    state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
+    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=True)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    batch_d = {"tokens": tokens}
+
+    state, m = step_fn(state, batch_d)   # compile
+    float(m["loss"])                     # host fetch = real barrier
+    t0 = time.time()
+    for _ in range(steps):
+        state, m = step_fn(state, batch_d)
+    float(m["loss"])
+    wall = time.time() - t0
+    step_s = wall / steps
+    tok_per_s = batch * seq / step_s
+    flops_per_s = cfg.flops_per_token() * tok_per_s
+    del state
+    return {
+        "compute_model_params_m": round(cfg.count_params() / 1e6, 1),
+        "compute_step_time_s": round(step_s, 4),
+        "compute_tokens_per_s": round(tok_per_s, 1),
+        "model_flops_per_s": round(flops_per_s / 1e12, 2),  # TFLOP/s
+        "mfu_pct": round(100.0 * flops_per_s / device_peak_flops(), 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: attention A/B (pallas vs XLA) on hardware
+# ---------------------------------------------------------------------------
+
+
+def _timed_op(fn, x, iters, overhead_s):
+    import jax
+    import jax.numpy as jnp
+
+    def scan_fn(x):
+        def body(carry, _):
+            out = fn(carry)
+            s = jnp.sum(out.astype(jnp.float32))
+            carry = carry + (s * 1e-30).astype(carry.dtype)
+            return carry, s
+
+        _, outs = jax.lax.scan(body, x, None, length=iters)
+        return outs[-1]
+
+    f = jax.jit(scan_fn)
+    float(f(x))  # compile
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        float(f(x))
+        best = min(best, time.time() - t0)
+    return (best - overhead_s) / iters
+
+
+def _call_overhead():
+    """Fixed per-call cost of this chip/tunnel (RTT + dispatch)."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jnp.ones((8, 128), jnp.bfloat16)
+
+    def scan_fn(z):
+        def body(c, _):
+            o = c * 1.000001
+            return o, jnp.sum(o.astype(jnp.float32))
+
+        _, outs = jax.lax.scan(body, z, None, length=100)
+        return outs[-1]
+
+    f = jax.jit(scan_fn)
+    float(f(z))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        float(f(z))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def attention_ab_phase():
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.attention import dot_product_attention
+    from dlrover_tpu.ops.pallas_attention import flash_attention
+
+    overhead = _call_overhead()
+    b, h, hkv, d = 4, 8, 8, 128
+    out = {"attn_ab_overhead_ms": round(overhead * 1e3, 1)}
+    for s in (1024, 4096):
+        q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(jax.random.key(1), (b, s, hkv, d), jnp.bfloat16)
+        v = jax.random.normal(jax.random.key(2), (b, s, hkv, d), jnp.bfloat16)
+
+        def g_xla(q):
+            return jax.grad(
+                lambda q: jnp.sum(
+                    dot_product_attention(q, k, v, causal=True).astype(
+                        jnp.float32
+                    )
+                )
+            )(q)
+
+        def g_pallas(q):
+            return jax.grad(
+                lambda q: jnp.sum(
+                    flash_attention(q, k, v, True).astype(jnp.float32)
+                )
+            )(q)
+
+        # Enough iterations that the per-iter signal dwarfs the ~100ms
+        # tunnel RTT jitter even at the small sequence length.
+        iters = 400 if s <= 2048 else 150
+        tx = _timed_op(g_xla, q, iters, overhead)
+        tp = _timed_op(g_pallas, q, iters, overhead)
+        out[f"attn_xla_ms_s{s}"] = round(tx * 1e3, 3)
+        out[f"attn_pallas_ms_s{s}"] = round(tp * 1e3, 3)
+        out[f"attn_pallas_speedup_s{s}"] = round(tx / tp, 2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: goodput under preemption
+# ---------------------------------------------------------------------------
+
+
+def build_goodput_model(platform: str):
     import jax
 
     from dlrover_tpu.models import llama
@@ -90,7 +271,7 @@ def build(platform: str):
     return cfg, mesh, state, step_fn, shardings, batch, seq, steps
 
 
-def main():
+def goodput_phase(platform: str):
     import jax
     import jax.numpy as jnp
 
@@ -99,9 +280,10 @@ def main():
         to_device_state,
     )
 
-    platform = jax.devices()[0].platform
     ckpt_dir = os.environ.get("BENCH_CKPT_DIR", "/tmp/dlrover_tpu_bench_ckpt")
-    (cfg, mesh, state, step_fn, shardings, batch, seq, steps) = build(platform)
+    (cfg, mesh, state, step_fn, shardings, batch, seq, steps) = (
+        build_goodput_model(platform)
+    )
     save_interval = max(steps // 3, 1)
 
     tokens = jax.random.randint(
@@ -172,8 +354,9 @@ def main():
     # Goodput at the reference's operating point: one failure per MTBF,
     # checkpoint every SAVE_EVERY_S. Downtime per failure = restore +
     # expected replay of half a checkpoint interval; overhead between
-    # failures = save blocks. (Process restart cost is excluded here; the
-    # elastic-agent restart path is benchmarked by tests/e2e.)
+    # failures = save blocks. (Process-restart cost is measured by
+    # bench_e2e.py through the real agent path; see
+    # measured_recovery_s in its output.)
     saves_per_mtbf = MTBF_S / SAVE_EVERY_S
     lost_steps = preempt_step % save_interval
     replay_ratio = (
@@ -187,27 +370,81 @@ def main():
     overhead = saves_per_mtbf * save_block_s
     goodput = 100.0 * MTBF_S / (MTBF_S + overhead + downtime)
 
-    print(
-        json.dumps(
-            {
-                "metric": "goodput_under_preemption",
-                "value": round(goodput, 2),
-                "unit": "%",
-                "vs_baseline": round(goodput / BASELINE_GOODPUT, 4),
-                "platform": platform,
-                "model_params_m": round(cfg.count_params() / 1e6, 1),
-                "raw_run_goodput": round(raw_goodput, 2),
-                "ckpt_save_block_s": round(save_block_s, 4),
-                "ckpt_drain_s": round(max(drain_s, final_drain), 4),
-                "ckpt_restore_s": round(restore_s, 4),
-                "replay_s": round(replay_s, 4),
-                "step_time_s": round(step_s, 4),
-                "tokens_per_s": round(batch * seq / step_s, 1),
-                "assumed_mtbf_s": MTBF_S,
-                "assumed_save_every_s": SAVE_EVERY_S,
-            }
-        )
+    return {
+        "metric": "goodput_under_preemption",
+        "value": round(goodput, 2),
+        "unit": "%",
+        "vs_baseline": round(goodput / BASELINE_GOODPUT, 4),
+        "platform": platform,
+        "model_params_m": round(cfg.count_params() / 1e6, 1),
+        "raw_run_goodput": round(raw_goodput, 2),
+        "ckpt_save_block_s": round(save_block_s, 4),
+        "ckpt_drain_s": round(max(drain_s, final_drain), 4),
+        "ckpt_restore_s": round(restore_s, 4),
+        "replay_s": round(replay_s, 4),
+        "step_time_s": round(step_s, 4),
+        "tokens_per_s": round(batch * seq / step_s, 1),
+        "assumed_mtbf_s": MTBF_S,
+        "assumed_save_every_s": SAVE_EVERY_S,
+    }
+
+
+def e2e_phase():
+    """Run bench_e2e.py (measured kill->restore->replay through the real
+    agent) in subprocesses. Must run BEFORE this process initializes the
+    TPU client — the e2e worker needs the chip."""
+    import subprocess
+    import sys
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py"
     )
+    proc = subprocess.run(
+        [sys.executable, path], capture_output=True, text=True, timeout=900
+    )
+    line = proc.stdout.strip().splitlines()[-1]
+    d = json.loads(line)
+    out = {"measured_recovery_s": d.get("value")}
+    for key in (
+        "detect_restart_s",
+        "runtime_init_s",
+        "restore_s",
+        "replay_s",
+        "replayed_steps",
+        "e2e_goodput_pct",
+        "e2e_goodput_vs_baseline",
+        "e2e_succeeded",
+    ):
+        if key in d:
+            out[key if key.startswith("e2e_") else f"e2e_{key}"] = d[key]
+    return out
+
+
+def main():
+    result = {}
+    if not os.environ.get("BENCH_SKIP_E2E") and not os.environ.get(
+        "BENCH_FAST"
+    ):
+        try:
+            result.update(e2e_phase())
+        except Exception as e:  # pragma: no cover - bench resilience
+            result["e2e_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform != "cpu" and not os.environ.get("BENCH_FAST"):
+        try:
+            result.update(compute_phase())
+        except Exception as e:  # pragma: no cover - bench resilience
+            result["compute_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            result.update(attention_ab_phase())
+        except Exception as e:  # pragma: no cover
+            result["attn_ab_error"] = f"{type(e).__name__}: {e}"[:200]
+    goodput = goodput_phase(platform)
+    goodput.update(result)
+    print(json.dumps(goodput))
 
 
 if __name__ == "__main__":

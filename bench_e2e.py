@@ -1,0 +1,324 @@
+"""Measured end-to-end recovery benchmark: SIGKILL a real supervised
+worker mid-training and time every phase of the comeback through the
+actual agent path — no modeling.
+
+Topology (same as the agent e2e tests, tests/test_elastic_agent.py):
+the parent process runs a LocalJobMaster + the agent-resident
+AsyncCheckpointSaver + an ElasticAgent on CPU; the worker subprocess
+(this file with --worker) trains a TpuLM on the accelerator, flash-
+checkpointing to agent shm. The parent kills the worker between
+checkpoints, the agent detects it, restarts it, and the new incarnation
+restores from shm and replays the lost steps.
+
+Measured phases (from the timestamped event log the worker writes):
+  detect_restart_s   kill -> new worker process boots (agent monitor +
+                     rendezvous + spawn)
+  runtime_init_s     boot -> JAX backend ready (TPU client init)
+  restore_s          backend ready -> state restored from agent shm
+  replay_s           restored -> training regained the pre-kill step
+  measured_recovery_s  sum: kill -> regained
+
+The JSON line also reports ``e2e_goodput_pct``: goodput at the
+reference's operating point (MTBF 3600s, save every 60s — the basis of
+DLRover's 69%->95% claim, README.md:61-63) using the MEASURED downtime
+including process restart, alongside the formula-only number bench.py
+prints. The worker enables JAX's persistent compilation cache so the
+restarted incarnation compiles from cache — exactly how a production
+TPU job restarts.
+
+Parity: the reference measures recovery the same way operationally
+(docs/blogs/flash_checkpoint.md restore-in-seconds claims) but has no
+in-repo harness for it; this file is that harness.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+MTBF_S = 3600.0
+SAVE_EVERY_S = 60.0
+BASELINE_GOODPUT = 95.0
+
+TOTAL_STEPS = 32
+SAVE_EVERY = 8
+KILL_AFTER_STEP = 20  # mid-interval: last landed save is step 16
+
+
+# ---------------------------------------------------------------------------
+# Worker mode
+# ---------------------------------------------------------------------------
+
+
+def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
+    def emit(event: str, **kw):
+        detail = " ".join(f"{k}={v}" for k, v in kw.items())
+        with open(events_path, "a") as f:
+            f.write(f"{time.time():.6f} {incarnation} {event} {detail}\n")
+
+    incarnation = int(os.getenv("DLROVER_TPU_RESTART_COUNT", "0"))
+    emit("boot")
+
+    import jax
+
+    if os.environ.get("BENCH_E2E_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    import jax.numpy as jnp
+
+    from dlrover_tpu.flash_ckpt.checkpointer import Checkpointer
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer import train_step as ts
+    from dlrover_tpu.trainer.runtime import init_distributed
+
+    ctx = init_distributed()
+    incarnation = ctx.restart_count
+    platform = jax.devices()[0].platform
+    emit("jax_ready", platform=platform)
+
+    if platform == "cpu":
+        cfg = llama.tiny_config()
+        batch, seq = 8, 64
+    else:
+        cfg = llama.TpuLMConfig(
+            vocab_size=4096,
+            embed_dim=256,
+            n_layers=4,
+            n_heads=8,
+            n_kv_heads=4,
+            head_dim=32,
+            mlp_dim=1024,
+            dtype="bfloat16",
+        )
+        batch, seq = 8, 512
+
+    mesh = build_mesh(MeshConfig(dp=len(jax.devices())), jax.devices())
+    tc = ts.TrainConfig(warmup_steps=10)
+    opt = ts.make_optimizer(tc)
+    state, specs = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
+    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=False)
+    shardings = ts.state_shardings(specs, mesh)
+
+    ckpt = Checkpointer(ckpt_dir)
+    restored = ckpt.load_checkpoint(sharding_tree=shardings)
+    if restored is not None:
+        rstep, state, _ = restored
+        jax.block_until_ready(state)
+        emit("restored", step=rstep)
+    else:
+        emit("fresh_start")
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    batch_d = {"tokens": tokens}
+
+    while int(state["step"]) < TOTAL_STEPS:
+        t0 = time.time()
+        state, m = step_fn(state, batch_d)
+        float(m["loss"])  # host fetch: the only reliable barrier
+        step = int(state["step"])
+        emit("step", n=step, dur=round(time.time() - t0, 4))
+        if step % SAVE_EVERY == 0:
+            # Async flash save: launch the DMA, overlap with next steps,
+            # then wait for it to land so the parent's kill always finds
+            # a restorable snapshot behind the kill step.
+            block = ckpt.save_checkpoint_async(step, state)
+            ckpt.wait_async_save()
+            emit("saved", n=step, block=round(block, 4))
+    ckpt.close()
+    emit("done")
+    sys.exit(0)
+
+
+# ---------------------------------------------------------------------------
+# Parent mode
+# ---------------------------------------------------------------------------
+
+
+def parse_events(path):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    for line in open(path):
+        parts = line.split()
+        t, inc, event = float(parts[0]), int(parts[1]), parts[2]
+        kw = dict(p.split("=", 1) for p in parts[3:])
+        rows.append((t, inc, event, kw))
+    return rows
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # accelerator belongs to
+    # the worker; the control plane (master/agent/saver) is host-only.
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.training import (
+        ElasticAgent,
+        RunResult,
+        WorkerSpec,
+    )
+    from dlrover_tpu.flash_ckpt.saver import AsyncCheckpointSaver
+    from dlrover_tpu.master.local_master import LocalJobMaster
+    from dlrover_tpu.master.node.job_context import JobContext
+
+    # Unique workdir per run: a previous run killed mid-flight leaves
+    # stale UDS sockets / shm ckpts that would poison this one. The jit
+    # cache is shared across runs on purpose (restart realism).
+    workdir = os.environ.get(
+        "BENCH_E2E_DIR", f"/tmp/dlrover_tpu_bench_e2e_{os.getpid()}"
+    )
+    os.makedirs(workdir, exist_ok=True)
+    events_path = os.path.join(workdir, f"events-{os.getpid()}.log")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    cache_dir = os.environ.get(
+        "BENCH_E2E_CACHE", "/tmp/dlrover_tpu_bench_e2e_cache"
+    )
+
+    os.environ["DLROVER_TPU_JOB_NAME"] = f"bench_e2e_{os.getpid()}"
+    os.environ["DLROVER_TPU_SHARED_DIR"] = os.path.join(workdir, "uds")
+    os.environ["DLROVER_TPU_NODE_RANK"] = "0"
+
+    JobContext.reset_singleton()
+    master = LocalJobMaster(port=0, node_num=1)
+    master.prepare()
+    client = MasterClient(f"localhost:{master.port}", node_id=0)
+    AsyncCheckpointSaver.reset()
+    saver = AsyncCheckpointSaver.start_async_saving_ckpt(client=client)
+
+    spec = WorkerSpec(
+        entrypoint=os.path.abspath(__file__),
+        args=["--worker", events_path, ckpt_dir, cache_dir],
+        nproc_per_node=1,
+        max_restarts=3,
+        node_rank=0,
+        monitor_interval=0.2,
+    )
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    box = {}
+
+    def run():
+        box["result"] = agent.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    # Wait until the first incarnation passes KILL_AFTER_STEP with a
+    # landed checkpoint behind it, then kill it hard (preemption).
+    deadline = time.time() + 900
+    t_kill = None
+    while time.time() < deadline:
+        rows = parse_events(events_path)
+        steps0 = [
+            int(kw["n"])
+            for _, inc, ev, kw in rows
+            if inc == 0 and ev == "step"
+        ]
+        saved0 = [
+            int(kw["n"])
+            for _, inc, ev, kw in rows
+            if inc == 0 and ev == "saved"
+        ]
+        if steps0 and max(steps0) >= KILL_AFTER_STEP and saved0:
+            pid = agent._workers[0].process.pid
+            t_kill = time.time()
+            os.kill(pid, signal.SIGKILL)
+            break
+        time.sleep(0.1)
+    assert t_kill is not None, "worker never reached the kill step"
+
+    t.join(timeout=900)
+    ok = box.get("result") == RunResult.SUCCEEDED
+    saver.unlink_all(2)
+    AsyncCheckpointSaver.reset()
+    master.stop()
+
+    rows = parse_events(events_path)
+    pre_kill = max(
+        int(kw["n"]) for _, inc, ev, kw in rows if inc == 0 and ev == "step"
+    )
+    ev1 = [(t_, ev, kw) for t_, inc, ev, kw in rows if inc >= 1]
+
+    def first(evname, pred=lambda kw: True):
+        for t_, ev, kw in ev1:
+            if ev == evname and pred(kw):
+                return t_, kw
+        return None, None
+
+    t_boot, _ = first("boot")
+    t_ready, _ = first("jax_ready")
+    t_restored, restored_kw = first("restored")
+    t_caught, _ = first("step", lambda kw: int(kw["n"]) >= pre_kill)
+    steps1 = [
+        (float(kw["dur"]))
+        for _, ev, kw in ev1
+        if ev == "step" and int(kw["n"]) > pre_kill
+    ]
+    save_blocks = [
+        float(kw["block"]) for _, inc, ev, kw in rows if ev == "saved"
+    ]
+    clean_steps = sorted(
+        float(kw["dur"])
+        for _, inc, ev, kw in rows
+        if ev == "step" and inc == 0
+    )
+    step_s = clean_steps[len(clean_steps) // 2] if clean_steps else 0.0
+
+    result = {
+        "metric": "measured_recovery_s",
+        "unit": "s",
+        "e2e_succeeded": ok,
+    }
+    if ok and t_caught is not None:
+        detect = t_boot - t_kill
+        init = t_ready - t_boot
+        restore = t_restored - t_ready
+        replay = t_caught - t_restored
+        recovery = t_caught - t_kill
+        lost_steps = pre_kill - int(restored_kw["step"])
+        # The first replayed step pays a one-time warmup (jit cache
+        # load + device transfer pipelining); steady replay then runs
+        # at clean speed. Model the warmup as one-time, not per-step.
+        replay_warmup = max(replay - lost_steps * step_s, 0.0)
+        # Goodput with MEASURED downtime: per failure, the process
+        # restart (detect+init+restore) plus the replay warmup plus
+        # replay of half a save interval at clean speed; plus the
+        # per-save overhead between failures.
+        save_block = sum(save_blocks) / max(len(save_blocks), 1)
+        downtime = (
+            detect + init + restore + replay_warmup + SAVE_EVERY_S / 2.0
+        )
+        overhead = (MTBF_S / SAVE_EVERY_S) * save_block
+        e2e_goodput = 100.0 * MTBF_S / (MTBF_S + overhead + downtime)
+        result.update(
+            value=round(recovery, 3),
+            detect_restart_s=round(detect, 3),
+            runtime_init_s=round(init, 3),
+            restore_s=round(restore, 3),
+            replay_s=round(replay, 3),
+            replayed_steps=lost_steps,
+            step_time_s=round(step_s, 4),
+            e2e_goodput_pct=round(e2e_goodput, 2),
+            e2e_goodput_vs_baseline=round(e2e_goodput / BASELINE_GOODPUT, 4),
+        )
+    print(json.dumps(result), flush=True)
+    # Hard exit: master/agent helper threads must not block teardown.
+    os._exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", nargs=3, metavar=("EVENTS", "CKPT", "CACHE"))
+    ns = ap.parse_args()
+    if ns.worker:
+        worker_main(*ns.worker)
+    else:
+        main()

@@ -53,6 +53,24 @@ class Checkpointer:
             return self._engine.save_to_storage(step, state, user_meta)
         return self._engine.save_to_memory(step, state, user_meta)
 
+    def save_checkpoint_async(
+        self,
+        step: int,
+        state: Any,
+        user_meta: Optional[dict] = None,
+    ) -> float:
+        """Launch the device->host DMA and return immediately (~ms).
+
+        The TPU hot path: the transfer overlaps the next training steps
+        and a writer thread lands it in shm. The caller must not donate
+        ``state`` to later steps (keep ``donate=False`` on the jitted
+        step). Use ``wait_async_save`` before relying on the snapshot.
+        """
+        return self._engine.save_to_memory_async(step, state, user_meta)
+
+    def wait_async_save(self, timeout: float = 600.0) -> bool:
+        return self._engine.wait_async_save(timeout)
+
     def load_checkpoint(
         self,
         step: Optional[int] = None,

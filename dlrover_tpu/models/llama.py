@@ -17,6 +17,7 @@ machinery has a real workload to supervise.
 
 import dataclasses
 import math
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -203,6 +204,32 @@ def init_params(
 # ---------------------------------------------------------------------------
 
 
+_ATTN_CACHE: Dict[str, Any] = {}
+
+
+def default_attention_fn():
+    """Best attention impl for contiguous-position causal attention on the
+    current backend: the Pallas flash kernel (ops/pallas_attention.py) on
+    TPU, the XLA reference op elsewhere (``None`` → transformer_layer's
+    ``dot_product_attention`` fallback).
+
+    Override with ``DLROVER_TPU_ATTN=xla|pallas`` (``pallas`` off-TPU runs
+    the kernel in interpret mode — for tests/debugging only).
+    """
+    choice = os.environ.get("DLROVER_TPU_ATTN", "auto").lower()
+    if choice not in _ATTN_CACHE:
+        use_pallas = choice == "pallas" or (
+            choice == "auto" and jax.default_backend() == "tpu"
+        )
+        if use_pallas:
+            from dlrover_tpu.ops.pallas_attention import make_flash_attention
+
+            _ATTN_CACHE[choice] = make_flash_attention()
+        else:
+            _ATTN_CACHE[choice] = None
+    return _ATTN_CACHE[choice]
+
+
 def transformer_layer(
     config: TpuLMConfig,
     layer_params: Dict[str, jnp.ndarray],
@@ -308,7 +335,15 @@ def forward(
 ):
     """Full forward. Dispatches to trainer/pipeline.py when
     pp_stages > 1. Returns (logits [b, s, vocab] f32, aux_loss scalar).
+
+    When the caller passes no explicit ``attention_fn`` and no explicit
+    ``positions`` (i.e. positions are the contiguous [0..s) default), the
+    attention impl is resolved by ``default_attention_fn`` — the Pallas
+    flash kernel on TPU. Callers with sharded/packed positions (ring
+    attention, SP meshes) pass their own ``attention_fn``.
     """
+    if attention_fn is None and positions is None:
+        attention_fn = default_attention_fn()
     if config.pp_stages > 1:
         from dlrover_tpu.trainer.pipeline import pipelined_forward
 

@@ -1,22 +1,31 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention: fused forward AND backward kernels.
 
 Online-softmax tiling: grid (batch*heads, q_blocks, kv_blocks) with the
 kv dimension innermost — TPU grids run sequentially, so the running
 (acc, m, l) live in VMEM scratch across kv iterations and the output
 block is written once on the last one. Q/K/V blocks stream HBM→VMEM via
-BlockSpec; the [block_q, block_k] logits tile hits the MXU. GQA is
-handled in the index map (query head -> kv head), never materialized.
+BlockSpec; the [block_q, block_k] logits tile hits the MXU in the input
+dtype (bf16 at full MXU rate) with f32 accumulation. GQA is handled in
+the index maps (query head -> kv head), never materialized.
 
-Backward: custom_vjp that recomputes through the XLA reference op
-(ops/attention.py) — numerically identical semantics (tests cross-check
-all three paths), trading backward FLOPs for O(seq^2) logits memory only
-inside the bwd pass. A fused Pallas backward is a later optimization.
+Backward (FlashAttention-2 style): the forward additionally writes the
+row log-sum-exp ``lse`` ([b*h, sq, 128] lane-broadcast, the layout trick
+of the official jax pallas kernel); the backward recomputes P per tile
+from (q, k, lse) and runs two kernels — one accumulating dq over kv
+blocks, one accumulating dk/dv over (group, q-block) pairs so GQA
+gradients sum across the query heads sharing a kv head. No O(s^2)
+tensor ever hits HBM in either direction.
 
 Used for the per-device block of full attention; ring attention
 (ops/ring_attention.py) handles the sequence-parallel case.
+
+Parity note: the reference delegates attention entirely to torch
+frameworks (SURVEY.md §2.9); this kernel is the TPU-native compute path
+its elastic machinery would supervise.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -26,16 +35,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dlrover_tpu.ops.attention import NEG_INF, dot_product_attention
 
+LANES = 128  # lane-broadcast width for per-row stats (lse, delta)
 
-def _pick_block(s: int, target: int = 256) -> int:
-    for cand in (target, 128, 64, 32, 16, 8):
+
+def _pick_block(s: int, target: int = 1024) -> int:
+    for cand in (target, 512, 256, 128, 64, 32, 16, 8):
         if s % cand == 0 and cand <= s:
             return cand
     return s
 
 
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     ki = pl.program_id(2)
@@ -53,14 +69,16 @@ def _flash_kernel(
 
     def body():
         # Blocks are (1, bq, d) or (1, 1, bq, d) depending on the layout
-        # path; normalize to 2D for the math.
-        q = q_ref[...].reshape(block_q, -1).astype(jnp.float32) * scale
-        k = k_ref[...].reshape(block_k, -1).astype(jnp.float32)
-        v = v_ref[...].reshape(block_k, -1).astype(jnp.float32)
+        # path; normalize to 2D for the math. Matmuls keep the input
+        # dtype (bf16 on TPU — full-rate MXU) and accumulate in f32;
+        # softmax math happens on the f32 logits.
+        q = q_ref[...].reshape(block_q, -1)
+        k = k_ref[...].reshape(block_k, -1)
+        v = v_ref[...].reshape(block_k, -1)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k]
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -79,7 +97,7 @@ def _flash_kernel(
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -98,6 +116,8 @@ def _flash_kernel(
         out = acc_ref[:] / jnp.maximum(l, 1e-30)
         out = jnp.where(m > NEG_INF / 2, out, 0.0)
         o_ref[...] = out.astype(o_ref.dtype).reshape(o_ref.shape)
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _flash_forward(q, k, v, causal, softmax_scale, interpret):
@@ -119,8 +139,6 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
     #   block dim equals the full array d — costs one HBM copy per
     #   operand, still far cheaper than materialized s^2 logits.
     if d % 128 == 0 or h == 1:
-        # Fold heads into the minor axis: free reshape, per-head d-slice
-        # picked by the block index map.
         operands = (
             q.reshape(b, sq, h * d),
             k.reshape(b, skv, hkv * d),
@@ -139,8 +157,6 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
             return out.reshape(b, sq, h, d)
 
     else:
-        # Transpose to [b, h, s, d]: minor block dim equals the array's
-        # full d. One HBM copy per operand.
         operands = (
             q.transpose(0, 2, 1, 3),
             k.transpose(0, 2, 1, 3),
@@ -165,24 +181,271 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
         block_q=block_q,
         block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(operands[0].shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(operands[0].shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(q_block, q_map),
             pl.BlockSpec(kv_block, kv_map),
             pl.BlockSpec(kv_block, kv_map),
         ],
-        out_specs=pl.BlockSpec(q_block, q_map),
+        out_specs=(
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(*operands)
-    return post(out)
+    return post(out), lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+#
+# Operands are pre-transposed to [b, h, s, d] (one HBM copy each — simple
+# uniform layout for both d%128==0 and d=64). Per-row stats (lse, delta)
+# ride as [b*h, sq, LANES] lane-broadcast f32.
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def body():
+        q = q_ref[...].reshape(block_q, -1)
+        k = k_ref[...].reshape(block_k, -1)
+        v = v_ref[...].reshape(block_k, -1)
+        do = do_ref[...].reshape(block_q, -1)
+        lse = lse_ref[...].reshape(block_q, LANES)[:, :1]
+        di = di_ref[...].reshape(block_q, LANES)[:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - di) * scale).astype(q.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(body)
+    else:
+        body()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[...] = dq_acc[:].astype(dq_ref.dtype).reshape(dq_ref.shape)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
+):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    k_start = pl.program_id(1) * block_k
+    q_start = (j % nq) * block_q
+
+    @pl.when(j == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def body():
+        q = q_ref[...].reshape(block_q, -1)
+        k = k_ref[...].reshape(block_k, -1)
+        v = v_ref[...].reshape(block_k, -1)
+        do = do_ref[...].reshape(block_q, -1)
+        lse = lse_ref[...].reshape(block_q, LANES)[:, :1]
+        di = di_ref[...].reshape(block_q, LANES)[:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        # dv += P^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - di) * scale).astype(q.dtype)
+        # dk += dS^T @ Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # q block entirely before the kv block contributes nothing.
+        pl.when(q_start + block_q - 1 >= k_start)(body)
+    else:
+        body()
+
+    @pl.when(j == nj - 1)
+    def _():
+        dk_ref[...] = dk_acc[:].astype(dk_ref.dtype).reshape(dk_ref.shape)
+        dv_ref[...] = dv_acc[:].astype(dv_ref.dtype).reshape(dv_ref.shape)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
+    b, sq, h, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = h // hkv
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # Backward holds more live tiles per grid step than forward; cap at
+    # 512 to stay comfortably inside VMEM with double buffering.
+    block_q = _pick_block(sq, target=512)
+    block_k = _pick_block(skv, target=512)
+    nq = sq // block_q
+
+    # delta_i = rowsum(dO * O) — cheap XLA elementwise+reduce, then
+    # lane-broadcast to the stats layout.
+    di = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [b, sq, h]
+    di = jnp.broadcast_to(
+        di.transpose(0, 2, 1).reshape(b * h, sq, 1), (b * h, sq, LANES)
+    )
+
+    qT = q.transpose(0, 2, 1, 3)        # [b, h, sq, d]
+    kT = k.transpose(0, 2, 1, 3)        # [b, hkv, skv, d]
+    vT = v.transpose(0, 2, 1, 3)
+    doT = g.transpose(0, 2, 1, 3)
+
+    q_block = (1, 1, block_q, d)
+    kv_block = (1, 1, block_k, d)
+    stat_block = (1, block_q, LANES)
+
+    # ---- dq: grid (b*h, q_blocks, kv_blocks) --------------------------
+    def q_map(bh, qi, ki):
+        return (bh // h, bh % h, qi, 0)
+
+    def kv_map(bh, qi, ki):
+        return (bh // h, (bh % h) // groups, ki, 0)
+
+    def stat_map(bh, qi, ki):
+        return (bh, qi, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        grid=(b * h, nq, skv // block_k),
+        in_specs=[
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec(kv_block, kv_map),
+            pl.BlockSpec(kv_block, kv_map),
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec(stat_block, stat_map),
+            pl.BlockSpec(stat_block, stat_map),
+        ],
+        out_specs=pl.BlockSpec(q_block, q_map),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qT, kT, vT, doT, lse, di)
+
+    # ---- dk/dv: grid (b*hkv, kv_blocks, groups*q_blocks) --------------
+    # The innermost axis walks every query head in the kv head's group
+    # and every q block, accumulating into one (dk, dv) tile — GQA
+    # gradients need exactly this cross-head sum.
+    def kv_map2(bkv, ki, j):
+        return (bkv // hkv, bkv % hkv, ki, 0)
+
+    def q_map2(bkv, ki, j):
+        return (bkv // hkv, (bkv % hkv) * groups + j // nq, j % nq, 0)
+
+    def stat_map2(bkv, ki, j):
+        bh = (bkv // hkv) * h + (bkv % hkv) * groups + j // nq
+        return (bh, j % nq, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, nq=nq,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(kT.shape, k.dtype),
+            jax.ShapeDtypeStruct(vT.shape, v.dtype),
+        ),
+        grid=(b * hkv, skv // block_k, groups * nq),
+        in_specs=[
+            pl.BlockSpec(q_block, q_map2),
+            pl.BlockSpec(kv_block, kv_map2),
+            pl.BlockSpec(kv_block, kv_map2),
+            pl.BlockSpec(q_block, q_map2),
+            pl.BlockSpec(stat_block, stat_map2),
+            pl.BlockSpec(stat_block, stat_map2),
+        ],
+        out_specs=(
+            pl.BlockSpec(kv_block, kv_map2),
+            pl.BlockSpec(kv_block, kv_map2),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qT, kT, vT, doT, lse, di)
+
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Public op
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -198,24 +461,34 @@ def flash_attention(
     defaults to True off-TPU so tests run on CPU.
     """
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    return _flash_forward(q, k, v, causal, softmax_scale, interpret)
+        interpret = jax.default_backend() != "tpu"
+    out, _ = _flash_forward(q, k, v, causal, softmax_scale, interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, softmax_scale, interpret):
-    out = flash_attention(q, k, v, causal, softmax_scale, interpret)
-    return out, (q, k, v)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, softmax_scale, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, softmax_scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(
-            q, k, v, causal=causal, softmax_scale=softmax_scale
-        ),
-        q, k, v,
+    q, k, v, out, lse = res
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if os.environ.get("DLROVER_TPU_FLASH_BWD", "pallas").lower() == "xla":
+        # Debug fallback: rebuild grads through the XLA reference op.
+        _, vjp = jax.vjp(
+            lambda q, k, v: dot_product_attention(
+                q, k, v, causal=causal, softmax_scale=softmax_scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, softmax_scale, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_fwd, _bwd)
