@@ -15,26 +15,39 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common.constants import ExitCode
+from dlrover_tpu.common.constants import (
+    HARDWARE_LOG_MARKERS,
+    OOM_LOG_MARKERS,
+    ExitCode,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
 
 # Log lines that indicate the TPU host itself is unhealthy; these make a
 # same-host restart pointless (reference uses exit codes + log inference).
 _HARDWARE_PATTERNS = [
-    re.compile(p, re.IGNORECASE)
-    for p in (
-        r"tpu.*(unavailable|unhealthy|not found)",
-        r"libtpu.*(fail|error)",
-        r"pjrt.*init.*fail",
-        r"device or resource busy",
-        r"uncorrectable ecc",
-    )
+    re.compile(p, re.IGNORECASE) for p in HARDWARE_LOG_MARKERS
 ]
 
+# Evidence filter: generic error-ish lines PLUS the OOM/hardware
+# markers — "RESOURCE_EXHAUSTED" or "uncorrectable ecc" must survive
+# the filter even without the word "error" on the line.
 _ERROR_LINE = re.compile(
-    r"error|exception|traceback|fatal|abort", re.IGNORECASE
+    "|".join(
+        (r"error|exception|traceback|fatal|abort",)
+        + OOM_LOG_MARKERS
+        + HARDWARE_LOG_MARKERS
+    ),
+    re.IGNORECASE,
 )
+
+# OOM signatures (shared with the master's classifier via
+# common/constants.py): an in-place restart with the same config just
+# OOMs again, so these escalate to relaunch and carry a reason hint the
+# master turns into an OOM record for the optimizer's memory bump.
+_OOM_PATTERNS = [
+    re.compile(p, re.IGNORECASE) for p in OOM_LOG_MARKERS
+]
 
 
 class WorkerAction:
@@ -97,6 +110,40 @@ class DiagnosisAgent:
             )
             return WorkerAction.RELAUNCH_NODE
         return WorkerAction.RESTART_WORKER
+
+    def consume_failure_evidence(self) -> List[str]:
+        """Error lines appended since the last failure — read ONCE per
+        failure and passed via FailureContext.log_tail so diagnosis and
+        classification see the same evidence (a second read would find
+        nothing: the scan offset advances)."""
+        return self._consume_new_error_logs()
+
+    def failure_reason(self, ctx: FailureContext) -> str:
+        """Classify the failure for the master's exit-reason taxonomy.
+
+        Returns a NodeExitReason value mined from exit codes and the
+        worker log tail; the agent sends it as a ``reason=X`` hint in
+        the failure report's error_data. Stale log lines from previous
+        incarnations must not leak in — callers pass the offset-tracked
+        lines from ``consume_failure_evidence``.
+        """
+        from dlrover_tpu.common.constants import ExitCode, NodeExitReason
+
+        lines = (
+            ctx.log_tail
+            if ctx.log_tail is not None
+            else self._consume_new_error_logs()
+        )
+        if any(p.search(ln) for ln in lines for p in _OOM_PATTERNS):
+            return NodeExitReason.OOM
+        if self._is_hardware_fault(ctx):
+            return NodeExitReason.HARDWARE_ERROR
+        codes = set(ctx.exit_codes.values())
+        if ExitCode.KILLED in codes:
+            return NodeExitReason.KILLED
+        if ExitCode.TERMED in codes:
+            return NodeExitReason.PREEMPTED
+        return NodeExitReason.SOFTWARE_ERROR
 
     def _is_hardware_fault(self, ctx: FailureContext) -> bool:
         if any(

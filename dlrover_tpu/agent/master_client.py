@@ -300,12 +300,15 @@ class MasterClient:
         )
 
     @retry_rpc
-    def report_task_done(self, dataset_name: str, task_id: int):
+    def report_task_done(
+        self, dataset_name: str, task_id: int, success: bool = True
+    ):
         return self._report(
             comm.TaskDoneReport(
                 dataset_name=dataset_name,
                 task_id=task_id,
                 node_id=self._node_id,
+                success=success,
             )
         )
 

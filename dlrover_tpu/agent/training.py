@@ -307,19 +307,28 @@ class ElasticAgent:
             WorkerAction,
         )
 
-        decision = self._diagnosis_agent.diagnose_training_failure(
-            FailureContext(
-                exit_codes=codes,
-                restart_count=self._restart_count,
-                max_restarts=self._spec.max_restarts,
-            )
+        ctx = FailureContext(
+            exit_codes=codes,
+            restart_count=self._restart_count,
+            max_restarts=self._spec.max_restarts,
+            # One offset-tracked read shared by diagnosis and the
+            # reason classifier: the scan offset advances per read, so
+            # two reads would leave the second one blind.
+            log_tail=self._diagnosis_agent.consume_failure_evidence(),
         )
+        decision = self._diagnosis_agent.diagnose_training_failure(ctx)
+        reason = self._diagnosis_agent.failure_reason(ctx)
+        from dlrover_tpu.common.constants import NodeExitReason
         from dlrover_tpu.training_event import AgentEvents
 
+        if reason == NodeExitReason.OOM:
+            # Restarting in place with the same config just OOMs again;
+            # escalate so the master's optimizer can bump resources.
+            decision = WorkerAction.RELAUNCH_NODE
         AgentEvents.worker_failure(codes, decision)
         try:
             self._client.report_failure(
-                error_data=str(codes),
+                error_data=f"reason={reason} codes={codes}",
                 node_rank=self._spec.node_rank,
                 restart_count=self._restart_count,
                 exit_code=next(iter(codes.values()), 1),

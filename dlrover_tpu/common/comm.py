@@ -306,12 +306,15 @@ class SyncQueryResponse(BaseResponse):
 @dataclass
 class DatasetShardParams(BaseRequest):
     dataset_name: str = ""
-    dataset_size: int = 0
+    dataset_size: int = 0  # -1 with a streaming storage_type = unbounded
     shard_size: int = 0  # records per task/shard
     num_epochs: int = 1
     shuffle: bool = False
-    storage_type: str = "text"
+    storage_type: str = "text"  # "table" | "text" | "stream"
     task_type: str = "training"
+    # Streaming sources (message queues / log stores) are partitioned;
+    # shards carry the partition they were carved from.
+    num_partitions: int = 1
 
 
 @dataclass
@@ -331,6 +334,9 @@ class ShardTask(BaseResponse):
     # Explicit (possibly shuffled) record indices for text datasets; None
     # means the contiguous [start, end) range.
     record_indices: Optional[List[int]] = None
+    # Source partition of a streaming shard ([start, end) offsets are
+    # per-partition for message-queue/log-store datasets).
+    partition: int = 0
 
 
 @dataclass
@@ -338,6 +344,9 @@ class TaskDoneReport(BaseRequest):
     dataset_name: str = ""
     task_id: int = -1
     node_id: int = 0
+    # False re-queues the shard (streaming sources retry a failed shard
+    # up to its retry budget before dropping it).
+    success: bool = True
 
 
 @dataclass

@@ -56,10 +56,46 @@ class NodeExitReason:
     SUCCEEDED = "Succeeded"
     KILLED = "Killed"
     OOM = "OOMKilled"
-    FATAL_ERROR = "FatalError"  # software error: do not relaunch forever
+    FATAL_ERROR = "FatalError"  # unrecoverable: never relaunch
+    SOFTWARE_ERROR = "SoftwareError"  # app crash: bounded relaunch
     HARDWARE_ERROR = "HardwareError"  # relaunch on a new machine
     PREEMPTED = "Preempted"  # cloud preemption: always relaunch
     UNKNOWN = "Unknown"
+
+
+# Relaunch budget per exit reason, as a multiple of a node's
+# max_relaunch_count (reference dist_job_manager.py:996 differentiates
+# reasons when deciding relaunch; the factors bound each failure mode
+# separately so a preemption storm can't be starved by one OOM and a
+# crash loop can't relaunch forever).
+# Worker-log markers shared by the agent's failure diagnosis and the
+# master's exit classifier — one source so the two sides never disagree.
+# OOM covers host RAM (MemoryError, oom-killer) and device HBM (XLA
+# RESOURCE_EXHAUSTED); hardware covers TPU/runtime init faults.
+OOM_LOG_MARKERS = (
+    r"resource_exhausted",
+    r"out of memory",
+    r"memoryerror",
+    r"oom[- _]?kill",
+    r"hbm.*exceed",
+)
+HARDWARE_LOG_MARKERS = (
+    r"tpu.*(unavailable|unhealthy|not found)",
+    r"libtpu.*(fail|error)",
+    r"pjrt.*init.*fail",
+    r"device or resource busy",
+    r"uncorrectable ecc",
+)
+
+RELAUNCH_BUDGET_FACTOR = {
+    NodeExitReason.PREEMPTED: 10.0,
+    NodeExitReason.KILLED: 2.0,
+    NodeExitReason.OOM: 1.0,
+    NodeExitReason.HARDWARE_ERROR: 1.0,
+    NodeExitReason.SOFTWARE_ERROR: 1.0,
+    NodeExitReason.UNKNOWN: 1.0,
+    NodeExitReason.FATAL_ERROR: 0.0,
+}
 
 
 class ExitCode:

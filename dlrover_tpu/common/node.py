@@ -11,6 +11,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from dlrover_tpu.common.constants import (
+    RELAUNCH_BUDGET_FACTOR,
     NodeEventType,
     NodeExitReason,
     NodeStatus,
@@ -95,6 +96,10 @@ class Node:
         self.relaunchable = True
         self.is_released = False
         self.exit_reason = ""
+        # Every classified exit of this rank's lineage (survives
+        # relaunches): drives the per-reason relaunch budgets
+        # (master/node/exit_reason.py).
+        self.exit_history: list = []
         # When the master asked the backend for this node; pending-timeout
         # is measured from here.
         self.create_time: Optional[float] = time.time()
@@ -129,20 +134,46 @@ class Node:
         return self.status in NodeStatus.end_states()
 
     def is_unrecoverable_failure(self) -> str:
-        """Return a non-empty reason if this node must not be relaunched."""
+        """Return a non-empty reason if this node must not be relaunched.
+
+        With a classified exit history, each reason spends its own
+        budget (RELAUNCH_BUDGET_FACTOR x max_relaunch_count): ten
+        preemptions must not be blocked by the generic cap, while a
+        crash loop exhausts its smaller budget quickly. Without history
+        (legacy callers), the flat relaunch_count cap applies.
+        """
         if not self.relaunchable:
             return "node not relaunchable"
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return "fatal software error"
+        if self.exit_history:
+            reason = self.exit_reason or NodeExitReason.UNKNOWN
+            budget = int(
+                self.max_relaunch_count
+                * RELAUNCH_BUDGET_FACTOR.get(reason, 1.0)
+            )
+            count = self.exit_count(reason)
+            if count > budget:
+                return (
+                    f"{reason} exits {count} > budget {budget} "
+                    f"(max_relaunch {self.max_relaunch_count})"
+                )
+            return ""
         if self.relaunch_count >= self.max_relaunch_count:
             return (
                 f"relaunch count {self.relaunch_count} >= "
                 f"max {self.max_relaunch_count}"
             )
-        if self.exit_reason == NodeExitReason.FATAL_ERROR:
-            return "fatal software error"
         return ""
 
     def inc_relaunch_count(self):
         self.relaunch_count += 1
+
+    def record_exit(self, reason: str):
+        self.exit_history.append(reason)
+
+    def exit_count(self, reason: str) -> int:
+        return self.exit_history.count(reason)
 
     def update_from_resource_stats(self, cpu: float, memory_mb: float):
         self.used_resource.cpu = cpu
@@ -160,6 +191,9 @@ class Node:
         new_node.is_released = False
         new_node.exit_reason = ""
         new_node.relaunch_count = self.relaunch_count + 1
+        # The lineage's exit history rides along (shared list: past
+        # exits are immutable facts about the rank, not the pod).
+        new_node.exit_history = self.exit_history
         new_node.used_resource = NodeResource()
         new_node.heartbeat_time = 0
         return new_node

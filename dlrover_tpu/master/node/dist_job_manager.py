@@ -31,6 +31,7 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node, NodeEvent, NodeGroupResource
 from dlrover_tpu.diagnosis.actions import DiagnosisAction, NodeAction
 from dlrover_tpu.master.node.event_callback import NodeEventCallback
+from dlrover_tpu.master.node.exit_reason import classify_exit
 from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.node.training_node import WorkerManager
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
@@ -253,6 +254,11 @@ class DistributedJobManager:
                 cb.on_node_succeeded(node)
         elif new_status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
             self._job_context.inc_failure_count()
+            # exit_reason and the recorded history must agree — the
+            # budget check counts exit_history entries matching
+            # exit_reason (common/node.py is_unrecoverable_failure).
+            node.exit_reason = node.exit_reason or NodeExitReason.UNKNOWN
+            node.record_exit(node.exit_reason)
             for cb in self._node_event_callbacks:
                 cb.on_node_failed(node)
             self._handle_node_gone(node)
@@ -263,6 +269,10 @@ class DistributedJobManager:
             # failure: relaunch only on the first transition into an
             # end state.
             if old_status not in NodeStatus.end_states():
+                node.exit_reason = (
+                    node.exit_reason or NodeExitReason.KILLED
+                )
+                node.record_exit(node.exit_reason)
                 self._handle_node_gone(node)
 
     def _handle_node_gone(self, node: Node):
@@ -286,23 +296,30 @@ class DistributedJobManager:
 
     def _should_relaunch(self, node: Node) -> bool:
         """Exit-reason relaunch policy (reference
-        dist_job_manager.py:996 _should_relaunch)."""
+        dist_job_manager.py:996 _should_relaunch).
+
+        Each classified reason spends its own relaunch budget
+        (common.constants.RELAUNCH_BUDGET_FACTOR via
+        Node.is_unrecoverable_failure): preemptions are near-free,
+        kills get double budget, OOM/hardware/software one budget
+        (OOM additionally triggers the optimizer's memory bump and the
+        strategy generator's remat escalation), fatal never relaunches.
+        """
         if self._job_context.job_stage != JobStage.RUNNING:
             return False
         if not self._relaunch_on_worker_failure:
             return False
         if node.status == NodeStatus.SUCCEEDED:
             return False
-        if node.is_unrecoverable_failure():
+        blocker = node.is_unrecoverable_failure()
+        if blocker:
+            logger.warning(
+                "no relaunch for %s (%s): %s",
+                node.name,
+                node.exit_reason or "unclassified",
+                blocker,
+            )
             return False
-        if node.exit_reason == NodeExitReason.FATAL_ERROR:
-            return False
-        if node.exit_reason == NodeExitReason.OOM:
-            # OOM on TPU hosts is host RAM; retry with the same shape but
-            # count it against the relaunch budget (the resource optimizer
-            # may bump host memory on the next plan).
-            return node.relaunch_count < node.max_relaunch_count
-        # KILLED / PREEMPTED / HARDWARE_ERROR / UNKNOWN -> replace the host.
         return True
 
     # ---- servicer surface (shared with LocalJobManager) ----------------------
@@ -352,6 +369,12 @@ class DistributedJobManager:
         if node is None:
             return
         node.relaunch_count = max(node.relaunch_count, report.restart_count)
+        # Classify from the agent's evidence (exit code + reason hint /
+        # log markers); the watcher's container-status reason, if any,
+        # stays authoritative.
+        reason = classify_exit(report.exit_code, report.error_data)
+        if reason and not node.exit_reason:
+            node.exit_reason = reason
         if report.level == TrainingExceptionLevel.NODE_ERROR:
             self._observe_failure(
                 node, node.exit_reason or NodeExitReason.KILLED

@@ -312,7 +312,7 @@ class MasterServicer(MasterService):
     def _report_task_done(self, msg, req: comm.TaskDoneReport):
         if self._task_manager is not None:
             self._task_manager.report_task_done(
-                req.dataset_name, req.task_id, req.node_id
+                req.dataset_name, req.task_id, req.node_id, req.success
             )
         return comm.BaseResponse(True)
 

@@ -18,6 +18,9 @@ class Shard:
     start: int
     end: int
     record_indices: Optional[List[int]] = None
+    # Source partition for streaming datasets ([start, end) offsets are
+    # per-partition in a message queue / log store).
+    partition: int = 0
 
 
 class DatasetSplitter(ABC):
@@ -107,6 +110,87 @@ class TextDatasetSplitter(DatasetSplitter):
         return shards
 
 
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Carve shards incrementally from an unbounded, partitioned source
+    (message queue / log store read by record offset).
+
+    Parity: reference master/shard/dataset_splitter.py:361
+    (StreamingDatasetSplitter) — ``dataset_size=-1`` means infinite;
+    each ``create_shards`` call carves at most ``fetch_shards`` new
+    shards, round-robin over partitions, advancing per-partition
+    offsets. The offsets (not epochs) are the progress state, so the
+    shard checkpoint captures them exactly.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        shard_size: int,
+        num_partitions: int = 1,
+        dataset_size: int = -1,
+        partition_offsets: Optional[dict] = None,
+        fetch_shards: int = 16,
+    ):
+        super().__init__(
+            dataset_name, dataset_size, shard_size, num_epochs=1
+        )
+        self.partition_offsets = dict(
+            partition_offsets
+            if partition_offsets is not None
+            else {p: 0 for p in range(max(num_partitions, 1))}
+        )
+        self._fetch_shards = fetch_shards
+        # Remaining records (-1 = unbounded); counts down for bounded
+        # streams so the tail shard is exact.
+        self.remaining = dataset_size if dataset_size >= 0 else -1
+        self._next_partition = 0
+
+    def create_shards(self) -> List[Shard]:
+        shards: List[Shard] = []
+        parts = sorted(self.partition_offsets)
+        for _ in range(self._fetch_shards):
+            if self.remaining == 0:
+                break
+            p = parts[self._next_partition % len(parts)]
+            self._next_partition += 1
+            start = self.partition_offsets[p]
+            take = self.shard_size
+            if self.remaining > 0:
+                take = min(take, self.remaining)
+                self.remaining -= take
+            self.partition_offsets[p] = start + take
+            shards.append(
+                Shard(
+                    name=self.dataset_name,
+                    start=start,
+                    end=start + take,
+                    partition=p,
+                )
+            )
+        return shards
+
+    def epoch_finished(self) -> bool:
+        # An unbounded stream never finishes; a bounded one finishes when
+        # every record has been carved into a shard.
+        return self.remaining == 0
+
+    def to_checkpoint(self) -> dict:
+        return {
+            "partition_offsets": {
+                str(p): o for p, o in self.partition_offsets.items()
+            },
+            "remaining": self.remaining,
+            "shard_size": self.shard_size,
+        }
+
+    def restore_checkpoint(self, state: dict):
+        self.partition_offsets = {
+            int(p): o for p, o in state["partition_offsets"].items()
+        }
+        self.remaining = state["remaining"]
+        self.shard_size = state.get("shard_size", self.shard_size)
+
+
 def create_dataset_splitter(
     storage_type: str,
     dataset_name: str,
@@ -114,7 +198,15 @@ def create_dataset_splitter(
     shard_size: int,
     num_epochs: int = 1,
     shuffle: bool = False,
+    num_partitions: int = 1,
 ) -> DatasetSplitter:
+    if storage_type in ("stream", "streaming", "kafka", "sls"):
+        return StreamingDatasetSplitter(
+            dataset_name,
+            shard_size,
+            num_partitions=num_partitions,
+            dataset_size=dataset_size,
+        )
     if storage_type == "text":
         return TextDatasetSplitter(
             dataset_name, dataset_size, shard_size, num_epochs, shuffle

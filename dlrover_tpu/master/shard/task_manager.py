@@ -17,6 +17,7 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.shard.dataset_splitter import (
     DatasetSplitter,
     Shard,
+    StreamingDatasetSplitter,
     create_dataset_splitter,
 )
 
@@ -75,10 +76,22 @@ class BatchDatasetManager:
             )
             self._task_id_seq += 1
 
-    def report_task_done(self, task_id: int, node_id: int) -> bool:
+    def report_task_done(
+        self, task_id: int, node_id: int, success: bool = True
+    ) -> bool:
         with self._lock:
             doing = self.doing.pop(task_id, None)
             if doing is None:
+                return False
+            if not success:
+                # The worker explicitly failed the shard: its records
+                # were NOT consumed — re-queue, don't count as done.
+                logger.warning(
+                    "task %d failed on node %d; re-queueing",
+                    task_id,
+                    node_id,
+                )
+                self.todo.insert(0, doing.task)
                 return False
             self._completed_count += 1
             return True
@@ -162,7 +175,8 @@ class TaskManager:
 
     def __init__(self, task_timeout: float = 1800.0, perf_monitor=None):
         self._lock = threading.Lock()
-        self._datasets: Dict[str, BatchDatasetManager] = {}
+        # BatchDatasetManager or StreamingDatasetManager (duck-typed).
+        self._datasets: Dict[str, object] = {}
         self._task_timeout = task_timeout
         self._perf_monitor = perf_monitor
         self._stopped = threading.Event()
@@ -198,13 +212,21 @@ class TaskManager:
                 params.shard_size,
                 params.num_epochs,
                 params.shuffle,
+                num_partitions=params.num_partitions,
             )
-            self._datasets[params.dataset_name] = BatchDatasetManager(
-                params.task_type, splitter
-            )
+            if isinstance(splitter, StreamingDatasetSplitter):
+                from dlrover_tpu.master.shard.streaming_dataset_manager import (  # noqa: E501
+                    StreamingDatasetManager,
+                )
+
+                manager = StreamingDatasetManager(params.task_type, splitter)
+            else:
+                manager = BatchDatasetManager(params.task_type, splitter)
+            self._datasets[params.dataset_name] = manager
             logger.info(
-                "dataset %s registered: size=%d shard=%d epochs=%d",
+                "dataset %s registered (%s): size=%d shard=%d epochs=%d",
                 params.dataset_name,
+                params.storage_type,
                 params.dataset_size,
                 params.shard_size,
                 params.num_epochs,
@@ -227,12 +249,19 @@ class TaskManager:
             end=task.shard.end,
             epoch=task.epoch,
             record_indices=task.shard.record_indices,
+            partition=task.shard.partition,
         )
 
-    def report_task_done(self, dataset_name: str, task_id: int, node_id: int):
+    def report_task_done(
+        self,
+        dataset_name: str,
+        task_id: int,
+        node_id: int,
+        success: bool = True,
+    ):
         mgr = self.get_dataset(dataset_name)
         if mgr is not None:
-            mgr.report_task_done(task_id, node_id)
+            mgr.report_task_done(task_id, node_id, success)
 
     def recover_node_tasks(self, node_id: int):
         with self._lock:

@@ -135,12 +135,14 @@ def test_fatal_error_is_not_relaunched():
 
 
 def test_relaunch_budget_exhausted():
+    # SOFTWARE_ERROR has a 1.0 budget factor (crash loops stop fast;
+    # KILLED/PREEMPTED are more generous — tests/test_exit_reasons.py).
     mgr, cluster = make_manager(node_num=1, max_relaunch=1)
     try:
         mgr.start()
         assert wait_until(lambda: len(running_nodes(mgr)) == 1)
         first = running_nodes(mgr)[0]
-        cluster.fail_node(first.id)
+        cluster.fail_node(first.id, NodeExitReason.SOFTWARE_ERROR)
         assert wait_until(
             lambda: any(
                 n.id != first.id and n.status == NodeStatus.RUNNING
@@ -150,7 +152,7 @@ def test_relaunch_budget_exhausted():
         second = [
             n for n in mgr.worker_manager.nodes.values() if n.id != first.id
         ][0]
-        cluster.fail_node(second.id)
+        cluster.fail_node(second.id, NodeExitReason.SOFTWARE_ERROR)
         assert wait_until(mgr.all_workers_exited)
         assert len(mgr.worker_manager.nodes) == 2
     finally:
