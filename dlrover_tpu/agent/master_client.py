@@ -88,6 +88,7 @@ class MasterClient:
         rdzv_name: str,
         node_unit: int = 1,
         node_ip: str = "",
+        node_group: int = -1,
     ) -> int:
         resp = self._report(
             comm.JoinRendezvousRequest(
@@ -97,6 +98,7 @@ class MasterClient:
                 rdzv_name=rdzv_name,
                 node_unit=node_unit,
                 node_ip=node_ip,
+                node_group=node_group,
             )
         )
         return getattr(resp, "round", 0)

@@ -59,6 +59,7 @@ def _run_probe(
                 rdzv_round=outcome.round,
             )
         )
+        env["DLROVER_TPU_CHECK_NODE_RANK"] = str(node_rank)
         procs.append(
             subprocess.Popen(
                 [
@@ -99,14 +100,15 @@ def run_network_check(
     nproc_per_node: int = 1,
     comm_perf: bool = False,
     timeout: float = NetworkCheckConstant.CHECK_TIMEOUT,
+    node_unit: int = 1,
 ) -> bool:
-    """Run up to two probe rounds; returns False if THIS node is faulty."""
+    """Run the probe rounds; returns False if THIS node is faulty."""
     from dlrover_tpu.training_event import AgentEvents
 
     span = AgentEvents.node_check().begin()
     try:
         ok = _run_network_check(
-            client, node_rank, nproc_per_node, comm_perf, timeout
+            client, node_rank, nproc_per_node, comm_perf, timeout, node_unit
         )
     except Exception as e:
         span.fail(str(e))
@@ -121,13 +123,18 @@ def _run_network_check(
     nproc_per_node: int = 1,
     comm_perf: bool = False,
     timeout: float = NetworkCheckConstant.CHECK_TIMEOUT,
+    node_unit: int = 1,
 ) -> bool:
-    for attempt in range(2):
+    # Up to 4 rounds: pair + bisect in the flat flow; the group-aware
+    # flow adds intra/inter phases (rdzv_manager.py
+    # GroupNetworkCheckRendezvousManager.MAX_PHASES).
+    for attempt in range(4):
         handler = MasterRendezvousHandler(
             client,
             node_rank,
             nproc_per_node,
             rdzv_name=RendezvousName.NETWORK_CHECK,
+            node_unit=node_unit,
             join_timeout=timeout,
         )
         outcome = handler.next_rendezvous()

@@ -12,6 +12,18 @@ import sys
 import time
 
 
+def _chaos_ranks(var: str) -> set:
+    return {
+        int(r)
+        for r in os.getenv(var, "").split(",")
+        if r.strip().lstrip("-").isdigit()
+    }
+
+
+def _my_node_rank() -> int:
+    return int(os.getenv("DLROVER_TPU_CHECK_NODE_RANK", "-1"))
+
+
 def main() -> int:
     result_file = sys.argv[1]
     matmul_size = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
@@ -70,6 +82,17 @@ def main() -> int:
 
         out = allreduce(sharded)
         jax.block_until_ready(out)
+
+    # Chaos/fault injection (operational chaos harness + e2e tests,
+    # chaos.py): a rigged rank straggles (sleeps inside the timed
+    # region) or fails its probe AFTER the collectives, so partners
+    # complete cleanly and the master's bisection isolates exactly the
+    # rigged node without waiting out collective timeouts.
+    rank = _my_node_rank()
+    if rank in _chaos_ranks("DLROVER_TPU_CHAOS_CHECK_SLOW_RANKS"):
+        time.sleep(float(os.getenv("DLROVER_TPU_CHAOS_CHECK_SLOW_SECS", "3")))
+    if rank in _chaos_ranks("DLROVER_TPU_CHAOS_CHECK_FAIL_RANKS"):
+        return 1  # no result file: the agent reports a failed probe
 
     elapsed = time.time() - start
     tmp = result_file + ".tmp"

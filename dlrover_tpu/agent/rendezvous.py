@@ -9,6 +9,7 @@ by rendezvous round, and every agent derives contiguous process ids from
 the world layout.
 """
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Dict
@@ -60,6 +61,16 @@ class MasterRendezvousHandler:
         self._poll_interval = poll_interval
         self._coordinator_port = coordinator_port
         _, self._node_ip = get_hostname_ip()
+        # TPU slice/block index of this host. Explicit env wins; with a
+        # node_unit (hosts per slice) configured, the block is derived
+        # from the rank so deployments need no extra wiring.
+        group_env = os.getenv("DLROVER_TPU_NODE_GROUP", "")
+        if group_env.strip():
+            self._node_group = int(group_env)
+        elif node_unit > 1:
+            self._node_group = node_rank // node_unit
+        else:
+            self._node_group = -1
 
     def _coordinator_key(self, rdzv_round: int, group: int) -> str:
         return f"rdzv/{self._rdzv_name}/{rdzv_round}/{group}/coordinator"
@@ -72,6 +83,7 @@ class MasterRendezvousHandler:
             self._rdzv_name,
             node_unit=self._node_unit,
             node_ip=self._node_ip,
+            node_group=self._node_group,
         )
         deadline = time.time() + self._join_timeout
         world: Dict[int, int] = {}

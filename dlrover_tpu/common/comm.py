@@ -47,8 +47,12 @@ class JoinRendezvousRequest(BaseRequest):
     node_rank: int = 0
     local_world_size: int = 1  # JAX processes per host (usually 1 on TPU)
     rdzv_name: str = ""
-    node_unit: int = 1  # node count must be a multiple of this
+    node_unit: int = 1  # hosts per slice: node count must be a multiple
     node_ip: str = ""
+    # TPU slice/block index of this host (-1 = ungrouped). Drives
+    # complete-group rendezvous, group-aware network check phases, and
+    # group-level relaunch.
+    node_group: int = -1
 
 
 @dataclass

@@ -108,9 +108,12 @@ class Node:
         self.heartbeat_time: float = 0.0
         self.restart_training = False
         self.critical = False
+        # TPU slice/block index (-1 = ungrouped); a hardware fault on
+        # one member relaunches the whole block together (ICI needs the
+        # full slice) while other blocks keep running.
+        self.node_group = -1
         self.migrated = False
         self.paral_config_version = -1
-        self.group: Optional[int] = None  # node group for grouped relaunch
         self.reported_status: str = ""
 
     # ---- status transitions -------------------------------------------------

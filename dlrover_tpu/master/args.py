@@ -59,6 +59,14 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="TPU chips per worker host (mesh suggestions)",
     )
     parser.add_argument(
+        "--node_unit",
+        type=int,
+        default=0,
+        help="hosts per TPU slice block: drives complete-group "
+        "rendezvous, slice-aware network check, and whole-block "
+        "relaunch on hardware faults (0 = ungrouped)",
+    )
+    parser.add_argument(
         "--auto_scale",
         action="store_true",
         default=False,

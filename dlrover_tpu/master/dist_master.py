@@ -58,6 +58,7 @@ class DistributedJobMaster:
         devices_per_node: int = 4,
         brain_addr: str = "",
         topology_aware: bool = False,
+        node_group_size: int = 0,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -78,6 +79,7 @@ class DistributedJobMaster:
             max_relaunch_count=max_relaunch_count,
             heartbeat_timeout_s=heartbeat_timeout_s,
             pending_timeout_s=pending_timeout_s,
+            node_group_size=node_group_size,
         )
         self.job_manager.add_node_event_callback(
             AllReduceNodeHandlingCallback(self)
@@ -253,6 +255,7 @@ class DistributedJobMaster:
             global_batch_size=getattr(args, "global_batch_size", 0),
             devices_per_node=getattr(args, "devices_per_node", 4),
             brain_addr=getattr(args, "brain_addr", ""),
+            node_group_size=getattr(args, "node_unit", 0),
             topology_aware=getattr(args, "topology_aware", False),
         )
 

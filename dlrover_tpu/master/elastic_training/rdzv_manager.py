@@ -40,6 +40,7 @@ class _WaitingNode:
     local_world_size: int
     join_time: float
     node_ip: str = ""
+    node_group: int = -1  # TPU slice/block index; -1 = ungrouped
 
 
 def default_legal_node_counts(max_nodes: int, node_unit: int) -> List[int]:
@@ -114,6 +115,7 @@ class RendezvousManager(ABC):
         node_rank: int,
         local_world_size: int,
         node_ip: str = "",
+        node_group: int = -1,
     ) -> int:
         with self._lock:
             if not self._waiting:
@@ -124,6 +126,7 @@ class RendezvousManager(ABC):
                 local_world_size=local_world_size,
                 join_time=time.time(),
                 node_ip=node_ip,
+                node_group=node_group,
             )
             logger.info(
                 "rdzv[%s] round %d: node rank %d joined (%d waiting)",
@@ -171,6 +174,41 @@ class RendezvousManager(ABC):
             return self._legal_world_size(n)
         return 0
 
+    def _grouped(self) -> bool:
+        return any(w.node_group >= 0 for w in self._waiting.values())
+
+    def _select_waiters(self, size: int) -> List[_WaitingNode]:
+        """Round participants, longest-waiting first (lowest rank on tie
+        so a flapping late joiner cannot evict a stable participant).
+
+        With node groups (TPU slices), only COMPLETE groups are eligible
+        — an ICI slice cannot run collectives with a missing host, and
+        holding back an incomplete block keeps the other blocks training
+        while its replacement host arrives. ``node_unit`` is the hosts-
+        per-slice count."""
+        waiters = sorted(
+            self._waiting.values(),
+            key=lambda w: (w.join_time, w.node_rank),
+        )
+        unit = self._params.node_unit
+        if unit <= 1 or not self._grouped():
+            return waiters[:size]
+        by_group: Dict[int, List[_WaitingNode]] = {}
+        for w in waiters:
+            by_group.setdefault(w.node_group, []).append(w)
+        complete = [
+            members[:unit]
+            for members in by_group.values()
+            if len(members) >= unit
+        ]
+        complete.sort(key=lambda g: min(w.join_time for w in g))
+        chosen: List[_WaitingNode] = []
+        for members in complete:
+            if len(chosen) + unit > size:
+                break
+            chosen.extend(members)
+        return chosen
+
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
     """The training rendezvous: single group 0, ranks 0..n-1.
@@ -188,6 +226,13 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
         self._topology_sorter = sorter
 
     def _order_world(self, world: Dict[int, int], chosen) -> Dict[int, int]:
+        groups = {w.node_rank: w.node_group for w in chosen}
+        if any(g >= 0 for g in groups.values()):
+            # Group-major order: each slice's hosts are contiguous in
+            # the rank order, so dp/allreduce neighbors ride ICI and
+            # only block boundaries cross DCN.
+            order = sorted(world, key=lambda r: (groups.get(r, -1), r))
+            return {rank: world[rank] for rank in order}
         if self._topology_sorter is None:
             return dict(sorted(world.items()))
         node_ips = {w.node_rank: w.node_ip for w in chosen}
@@ -203,13 +248,8 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
             if node_rank in self._latest_world and node_rank not in self._waiting:
                 return self._rdzv_round - 1, 0, dict(self._latest_world)
             size = self._round_ready()
-            if size:
-                # Prefer longest-waiting nodes (lowest rank on tie) so a
-                # flapping late joiner cannot evict a stable participant.
-                chosen = sorted(
-                    self._waiting.values(),
-                    key=lambda w: (w.join_time, w.node_rank),
-                )[:size]
+            chosen = self._select_waiters(size) if size else []
+            if chosen:
                 world = {
                     w.node_rank: w.local_world_size for w in chosen
                 }
@@ -276,6 +316,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         node_rank: int,
         local_world_size: int,
         node_ip: str = "",
+        node_group: int = -1,
     ) -> int:
         # A join after a concluded check starts a FRESH check cycle
         # (e.g. a relaunched node re-running its health probes, or a
@@ -284,7 +325,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if self._check_concluded():
                 self._reset_check_locked()
         return super().join_rendezvous(
-            node_id, node_rank, local_world_size, node_ip
+            node_id, node_rank, local_world_size, node_ip, node_group
         )
 
     def get_comm_world(self, node_rank: int):
@@ -317,43 +358,47 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     return self._rdzv_round - 1, group_idx, dict(group)
             return self._rdzv_round, 0, {}
 
+    @staticmethod
+    def _pair_adjacent(
+        ranks: List[int], world: Dict[int, int]
+    ) -> List[Dict[int, int]]:
+        """Pairs (0,1) (2,3) ...; an odd node joins the last group."""
+        groups: List[Dict[int, int]] = []
+        for i in range(0, len(ranks) - 1, 2):
+            groups.append({r: world[r] for r in (ranks[i], ranks[i + 1])})
+        if len(ranks) % 2 == 1:
+            if groups:
+                groups[-1][ranks[-1]] = world[ranks[-1]]
+            else:
+                groups.append({ranks[-1]: world[ranks[-1]]})
+        return groups
+
+    def _pair_suspects(
+        self, suspects: List[int], healthy: List[int], world
+    ) -> List[Dict[int, int]]:
+        """Each suspect pairs with a healthy node (bisection); leftover
+        healthy nodes pair adjacently; a partnerless suspect probes
+        solo."""
+        groups: List[Dict[int, int]] = []
+        pool = list(healthy)
+        for s in suspects:
+            if pool:
+                h = pool.pop(0)
+                groups.append({s: world[s], h: world[h]})
+            else:
+                groups.append({s: world[s]})
+        groups.extend(self._pair_adjacent(pool, world))
+        return groups
+
     def _group_nodes(
         self, check_round: int, world: Dict[int, int]
     ) -> List[Dict[int, int]]:
         ranks = sorted(world)
-        groups: List[Dict[int, int]] = []
         if check_round == 0 or not self._node_status:
-            # pairs: (0,1) (2,3) ...; odd node appended to last group
-            for i in range(0, len(ranks) - 1, 2):
-                groups.append(
-                    {r: world[r] for r in (ranks[i], ranks[i + 1])}
-                )
-            if len(ranks) % 2 == 1:
-                if groups:
-                    groups[-1][ranks[-1]] = world[ranks[-1]]
-                else:
-                    groups.append({ranks[-1]: world[ranks[-1]]})
-        else:
-            # round 1: suspect + healthy pairs
-            suspects = [r for r in ranks if not self._node_status.get(r, True)]
-            healthy = [r for r in ranks if self._node_status.get(r, True)]
-            used_healthy: List[int] = []
-            for s in suspects:
-                if healthy:
-                    h = healthy.pop(0)
-                    groups.append({s: world[s], h: world[h]})
-                    used_healthy.append(h)
-                else:
-                    groups.append({s: world[s]})
-            rest = healthy
-            for i in range(0, len(rest) - 1, 2):
-                groups.append({r: world[r] for r in (rest[i], rest[i + 1])})
-            if len(rest) % 2 == 1:
-                if groups:
-                    groups[-1][rest[-1]] = world[rest[-1]]
-                else:
-                    groups.append({rest[-1]: world[rest[-1]]})
-        return groups
+            return self._pair_adjacent(ranks, world)
+        suspects = [r for r in ranks if not self._node_status.get(r, True)]
+        healthy = [r for r in ranks if self._node_status.get(r, True)]
+        return self._pair_suspects(suspects, healthy, world)
 
     def report_network_check_result(
         self, node_rank: int, succeeded: bool, elapsed: float
@@ -439,8 +484,220 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._eval_results.clear()
 
 
+class GroupCheckPhase:
+    INTRA = "intra"
+    INTRA_DIAG = "intra_diag"
+    INTER = "inter"
+    INTER_DIAG = "inter_diag"
+
+
+class GroupNetworkCheckRendezvousManager(NetworkCheckRendezvousManager):
+    """Slice-aware network check (reference rdzv_manager.py:876
+    GroupNodeNetworkCheckRendezvousManager, re-shaped for TPU blocks).
+
+    Hosts belong to node groups (TPU slices: ICI inside a group, DCN
+    across groups). Phases:
+
+    - INTRA: adjacent pairs within each slice probe the ICI path.
+      Failures enter INTRA_DIAG (suspect + intra-group healthy pairing,
+      bisecting to the faulty host — verdict final).
+    - A clean intra pass advances to INTER: same-position hosts of
+      adjacent slices pair up to probe DCN. Failures enter INTER_DIAG
+      (suspect + healthy-from-another-group pairing — verdict final).
+
+    Without group info every phase falls back to the base pair/bisect
+    flow, so ungrouped jobs see identical behavior.
+    """
+
+    MAX_PHASES = 4
+
+    def __init__(self):
+        super().__init__()
+        self._rank_group: Dict[int, int] = {}
+        self._phase = GroupCheckPhase.INTRA
+        self._concluded = False
+        # True only while the CURRENT cycle's world is fully grouped —
+        # evaluation, conclusion, and verdict must all branch on the
+        # same predicate, or mixed group info (one agent without a
+        # group) would leave the check permanently unconcluded.
+        self._grouped_mode = False
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        node_rank: int,
+        local_world_size: int,
+        node_ip: str = "",
+        node_group: int = -1,
+    ) -> int:
+        with self._lock:
+            if node_group >= 0:
+                self._rank_group[node_rank] = node_group
+        return super().join_rendezvous(
+            node_id, node_rank, local_world_size, node_ip, node_group
+        )
+
+    # ---- phase machinery ---------------------------------------------------
+
+    def _groups_of(self, world: Dict[int, int]):
+        by: Dict[int, List[int]] = {}
+        for r in sorted(world):
+            g = self._rank_group.get(r, -1)
+            if g < 0:
+                return None  # mixed/absent group info: fall back
+            by.setdefault(g, []).append(r)
+        return by if len(by) >= 1 else None
+
+    def _check_concluded(self) -> bool:
+        if not self._grouped_mode:
+            return super()._check_concluded()
+        return self._concluded
+
+    def _reset_check_locked(self):
+        super()._reset_check_locked()
+        self._phase = GroupCheckPhase.INTRA
+        self._concluded = False
+        self._grouped_mode = False
+        # _rank_group survives: slice membership is a fact about the
+        # host, not about one check cycle.
+
+    def _group_nodes(self, check_round, world):
+        by = self._groups_of(world)
+        self._grouped_mode = by is not None
+        if by is None:
+            return super()._group_nodes(check_round, world)
+        phase = self._phase
+        if phase == GroupCheckPhase.INTRA:
+            groups = []
+            for ranks in by.values():
+                groups.extend(self._pair_adjacent(ranks, world))
+            return groups
+        if phase == GroupCheckPhase.INTRA_DIAG:
+            # Bisect within each slice: a cross-slice pairing would
+            # probe DCN and prove nothing about the suspect ICI path.
+            # A fully-suspect block degenerates to solo host probes —
+            # a host fault is isolated directly; a pure ICI-link fault
+            # passes solo probes and resurfaces at the next training
+            # rendezvous, where the block relaunches whole.
+            groups = []
+            for ranks in by.values():
+                suspects = [
+                    r for r in ranks if not self._node_status.get(r, True)
+                ]
+                healthy = [
+                    r for r in ranks if self._node_status.get(r, True)
+                ]
+                groups.extend(self._pair_suspects(suspects, healthy, world))
+            return groups
+        if phase == GroupCheckPhase.INTER:
+            # Same-position hosts of adjacent slices probe DCN.
+            glist = sorted(by)
+            groups = []
+            for i in range(0, len(glist) - 1, 2):
+                a, b = by[glist[i]], by[glist[i + 1]]
+                for x, y in zip(a, b):
+                    groups.append({x: world[x], y: world[y]})
+                for rest in (a[len(b):], b[len(a):]):
+                    groups.extend(self._pair_adjacent(rest, world))
+            if len(glist) % 2 == 1:
+                groups.extend(self._pair_adjacent(by[glist[-1]], world))
+            return groups
+        # INTER_DIAG: suspect + healthy host from a DIFFERENT slice than
+        # the suspect's, so a bad DCN link is bisected to the host.
+        suspects = [r for r in sorted(world) if not self._node_status.get(r, True)]
+        groups = []
+        used = set(suspects)
+        for s in suspects:
+            partner = next(
+                (
+                    r
+                    for r in sorted(world)
+                    if r not in used
+                    and self._node_status.get(r, True)
+                    and self._rank_group.get(r) != self._rank_group.get(s)
+                ),
+                None,
+            )
+            if partner is None:
+                groups.append({s: world[s]})
+            else:
+                used.add(partner)
+                groups.append({s: world[s], partner: world[partner]})
+        leftovers = [r for r in sorted(world) if r not in used]
+        groups.extend(self._pair_adjacent(leftovers, world))
+        return groups
+
+    def _maybe_evaluate_round(self):
+        expected = set(self._latest_world)
+        if not expected or not (set(self._reported) >= expected):
+            return
+        if not self._grouped_mode:
+            super()._maybe_evaluate_round()
+            return
+        if self._check_round in self._eval_results:
+            return
+        suspects = sorted(
+            r for r, ok in self._node_status.items() if not ok
+        )
+        self._evaluate_stragglers()
+        phase = self._phase
+
+        def advance(next_phase):
+            self._eval_results[self._check_round] = []
+            self._check_round += 1
+            self._phase = next_phase
+            self._node_groups = []
+            self._reported = {}
+
+        def conclude(faults):
+            self._eval_results[self._check_round] = list(faults)
+            self._concluded = True
+            logger.info(
+                "group network check concluded at %s: faults=%s",
+                phase,
+                faults,
+            )
+
+        if phase == GroupCheckPhase.INTRA:
+            if suspects:
+                logger.info(
+                    "intra-slice suspects %s; running intra diagnosis",
+                    suspects,
+                )
+                advance(GroupCheckPhase.INTRA_DIAG)
+            else:
+                logger.info("intra-slice checks clean; probing DCN")
+                advance(GroupCheckPhase.INTER)
+        elif phase == GroupCheckPhase.INTRA_DIAG:
+            conclude(suspects)
+        elif phase == GroupCheckPhase.INTER:
+            if suspects:
+                logger.info(
+                    "inter-slice suspects %s; running inter diagnosis",
+                    suspects,
+                )
+                advance(GroupCheckPhase.INTER_DIAG)
+            else:
+                conclude([])
+        else:
+            conclude(suspects)
+
+    def check_fault_node(self) -> Tuple[List[int], int, bool]:
+        with self._lock:
+            if not self._grouped_mode:
+                return super().check_fault_node()
+            if not self._eval_results:
+                return [], -1, False
+            last = max(self._eval_results)
+            return (
+                list(self._eval_results[last]),
+                last,
+                not self._concluded,
+            )
+
+
 def create_rdzv_managers() -> Dict[str, RendezvousManager]:
     return {
         RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
-        RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        RendezvousName.NETWORK_CHECK: GroupNetworkCheckRendezvousManager(),
     }

@@ -52,6 +52,7 @@ class DistributedJobManager:
         heartbeat_timeout_s: float = 600.0,
         pending_timeout_s: float = 900.0,
         relaunch_on_worker_failure: bool = True,
+        node_group_size: int = 0,
     ):
         self._job_name = job_name
         self._job_context = get_job_context()
@@ -61,6 +62,11 @@ class DistributedJobManager:
         self._heartbeat_timeout_s = heartbeat_timeout_s
         self._pending_timeout_s = pending_timeout_s
         self._relaunch_on_worker_failure = relaunch_on_worker_failure
+        # Hosts per TPU slice (0/1 = no grouping): drives group
+        # assignment at init and whole-block relaunch on hardware
+        # faults (reference dist_job_manager.py:1128
+        # _relaunch_node_group).
+        self._node_group_size = node_group_size
         self._node_event_callbacks: List[NodeEventCallback] = []
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -78,7 +84,10 @@ class DistributedJobManager:
             NodeType.WORKER, NodeGroupResource(count=1)
         )
         self._worker_manager = WorkerManager(
-            worker_group, self._new_node_id, max_relaunch_count
+            worker_group,
+            self._new_node_id,
+            max_relaunch_count,
+            node_group_size=node_group_size,
         )
         self._managers = {NodeType.WORKER: self._worker_manager}
 
@@ -229,6 +238,7 @@ class DistributedJobManager:
             if node.status not in NodeStatus.end_states():
                 new_status = NodeStatus.DELETED
             node.is_released = True
+        retired = not node.relaunchable
         old_status = node.status
         if not node.update_status(new_status):
             return
@@ -254,21 +264,28 @@ class DistributedJobManager:
                 cb.on_node_succeeded(node)
         elif new_status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
             self._job_context.inc_failure_count()
-            # exit_reason and the recorded history must agree — the
-            # budget check counts exit_history entries matching
-            # exit_reason (common/node.py is_unrecoverable_failure).
-            node.exit_reason = node.exit_reason or NodeExitReason.UNKNOWN
-            node.record_exit(node.exit_reason)
             for cb in self._node_event_callbacks:
                 cb.on_node_failed(node)
-            self._handle_node_gone(node)
+            # An intentionally-retired record (e.g. a healthy block
+            # member torn down by a group relaunch) must not write into
+            # the lineage's exit history — that would silently erode
+            # the budget of a host that never failed.
+            if not retired:
+                # exit_reason and the recorded history must agree — the
+                # budget check counts exit_history entries matching
+                # exit_reason (common/node.py is_unrecoverable_failure).
+                node.exit_reason = (
+                    node.exit_reason or NodeExitReason.UNKNOWN
+                )
+                node.record_exit(node.exit_reason)
+                self._handle_node_gone(node)
         elif new_status == NodeStatus.DELETED:
             for cb in self._node_event_callbacks:
                 cb.on_node_deleted(node)
             # Deleting an already-finished node is cleanup, not a new
             # failure: relaunch only on the first transition into an
             # end state.
-            if old_status not in NodeStatus.end_states():
+            if old_status not in NodeStatus.end_states() and not retired:
                 node.exit_reason = (
                     node.exit_reason or NodeExitReason.KILLED
                 )
@@ -276,6 +293,18 @@ class DistributedJobManager:
                 self._handle_node_gone(node)
 
     def _handle_node_gone(self, node: Node):
+        if (
+            self._node_group_size > 1
+            and node.node_group >= 0
+            and node.exit_reason == NodeExitReason.HARDWARE_ERROR
+            and self._should_relaunch(node)
+        ):
+            # A broken host invalidates its whole ICI slice: the block's
+            # hosts must be replaced TOGETHER (a fresh slice), while
+            # other blocks keep their processes and simply re-rendezvous
+            # when the replacement block arrives.
+            self._relaunch_node_group(node.node_group)
+            return
         if self._should_relaunch(node):
             new_node, plan = self._worker_manager.relaunch_node(node)
             if new_node is not None:
@@ -293,6 +322,40 @@ class DistributedJobManager:
                 self._scaler.scale(plan)
                 return
         logger.warning("node %s will not be relaunched", node.name)
+
+    def _relaunch_node_group(self, group_idx: int):
+        """Relaunch every member of a slice block in one scale plan
+        (reference dist_job_manager.py:1128 _relaunch_node_group)."""
+        members = [
+            n
+            for n in self._worker_manager.latest_nodes()
+            if n.node_group == group_idx
+        ]
+        plan = ScalePlan()
+        relaunched = []
+        for m in members:
+            new_node, p = self._worker_manager.relaunch_node(m)
+            # The old incarnation gets torn down by this plan; its later
+            # DELETED event must not trigger a second relaunch.
+            m.relaunchable = False
+            self._job_context.update_node(m)
+            if new_node is None:
+                continue
+            new_node.node_group = group_idx
+            new_node.relaunchable = True
+            self._job_context.update_node(new_node)
+            relaunched.append((m, new_node))
+            plan.launch_nodes.extend(p.launch_nodes)
+            plan.remove_nodes.extend(p.remove_nodes)
+        logger.warning(
+            "relaunching slice block %d: %s",
+            group_idx,
+            [f"{m.name}->{n.name}" for m, n in relaunched],
+        )
+        for m, n in relaunched:
+            MasterEvents.node_relaunch(m.id, m.rank_index, m.exit_reason)
+        if not plan.empty():
+            self._scaler.scale(plan)
 
     def _should_relaunch(self, node: Node) -> bool:
         """Exit-reason relaunch policy (reference

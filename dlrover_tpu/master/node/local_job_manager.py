@@ -11,6 +11,8 @@ from typing import List, Optional
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import (
     JobStage,
+    NodeEventType,
+    NodeExitReason,
     NodeStatus,
     NodeType,
     TrainingExceptionLevel,
@@ -86,6 +88,15 @@ class LocalJobManager:
             report.reason,
             report.message,
         )
+        if report.event_type == NodeEventType.NODE_CHECK_FAILED:
+            # Same semantics as the distributed manager: a node that
+            # failed its health probes is broken hardware, evicted from
+            # scheduling until relaunched.
+            node = self._job_context.get_node(NodeType.WORKER, report.node_id)
+            if node is not None:
+                node.exit_reason = NodeExitReason.HARDWARE_ERROR
+                node.update_status(NodeStatus.BREAKDOWN)
+                self._job_context.update_node(node)
 
     def update_node_resource_usage(self, stats: comm.ResourceStats):
         node = self._job_context.get_node(NodeType.WORKER, stats.node_id)

@@ -24,11 +24,14 @@ class TrainingNodeManager:
         group_resource: NodeGroupResource,
         new_node_id_fn,
         max_relaunch_count: int = 3,
+        node_group_size: int = 0,
     ):
         self._node_type = node_type
         self._group_resource = group_resource
         self._new_node_id_fn = new_node_id_fn
         self._max_relaunch_count = max_relaunch_count
+        # Hosts per TPU slice block; >1 assigns node.node_group at init.
+        self._node_group_size = node_group_size
         self._lock = threading.Lock()
         self._nodes: Dict[int, Node] = {}
 
@@ -46,7 +49,7 @@ class TrainingNodeManager:
         with self._lock:
             for rank in range(self._group_resource.count):
                 node_id = self._new_node_id_fn()
-                self._nodes[node_id] = Node(
+                node = Node(
                     self._node_type,
                     node_id,
                     rank_index=rank,
@@ -55,6 +58,9 @@ class TrainingNodeManager:
                     ),
                     max_relaunch_count=self._max_relaunch_count,
                 )
+                if self._node_group_size > 1:
+                    node.node_group = rank // self._node_group_size
+                self._nodes[node_id] = node
             return list(self._nodes.values())
 
     def update_node(self, node: Node):
@@ -142,6 +148,10 @@ class TrainingNodeManager:
                 by_rank[node.rank_index] = node
         return list(by_rank.values())
 
+    def latest_nodes(self) -> List[Node]:
+        with self._lock:
+            return self._latest_incarnations()
+
     def first_pending_since(self) -> float:
         """Earliest create_time among still-pending nodes (0 if none)."""
         pending = self.pending_nodes()
@@ -161,12 +171,14 @@ class WorkerManager(TrainingNodeManager):
         group_resource: NodeGroupResource,
         new_node_id_fn,
         max_relaunch_count: int = 3,
+        node_group_size: int = 0,
     ):
         super().__init__(
             NodeType.WORKER,
             group_resource,
             new_node_id_fn,
             max_relaunch_count,
+            node_group_size,
         )
 
     def adjust_worker(self, target_count: int) -> ScalePlan:

@@ -152,7 +152,11 @@ class MasterServicer(MasterService):
             return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
         mgr.set_node_unit(req.node_unit)
         rdzv_round = mgr.join_rendezvous(
-            req.node_id, req.node_rank, req.local_world_size, req.node_ip
+            req.node_id,
+            req.node_rank,
+            req.local_world_size,
+            req.node_ip,
+            req.node_group,
         )
         if self._job_manager is not None:
             self._job_manager.handle_node_joined(req.node_id, req.node_rank)

@@ -183,6 +183,7 @@ def run(args) -> int:
             node_rank=node_rank,
             nproc_per_node=args.nproc_per_node,
             comm_perf=args.comm_perf_test,
+            node_unit=args.node_unit,
         )
         if not ok:
             logger.error("node failed network check; exiting for relaunch")

@@ -1,6 +1,8 @@
-"""Network/node check e2e on the virtual CPU backend: two agents probe in
-pairs against a real in-process master (reference: tests around
-NodeCheckElasticAgent + rdzv NETWORK_CHECK)."""
+"""Network/node check e2e on the virtual CPU backend: real agents probe
+in pairs against a real in-process master, including fault-injection
+runs where the master's bisection must isolate exactly the rigged node
+(reference: tests around NodeCheckElasticAgent + rdzv NETWORK_CHECK,
+rdzv_manager.py:684-858)."""
 
 import threading
 import time
@@ -9,37 +11,82 @@ import pytest
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.node_check import run_network_check
-from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.constants import NodeStatus, NodeType, RendezvousName
 from dlrover_tpu.master.local_master import LocalJobMaster
 
 
 @pytest.fixture()
-def master(monkeypatch, tmp_path):
+def make_master(monkeypatch, tmp_path):
     from dlrover_tpu.master.node.job_context import JobContext
 
     monkeypatch.setenv("DLROVER_TPU_SHARED_DIR", str(tmp_path / "uds"))
-    JobContext.reset_singleton()
-    m = LocalJobMaster(port=0, node_num=2)
-    m.prepare()
-    yield m
-    m.stop()
+    created = []
+
+    def build(node_num):
+        JobContext.reset_singleton()
+        m = LocalJobMaster(port=0, node_num=node_num)
+        m.prepare()
+        created.append(m)
+        return m
+
+    yield build
+    for m in created:
+        m.stop()
 
 
-def test_two_node_check_all_healthy(master):
+def run_agents(master, ranks, timeout=240):
     results = {}
 
     def check(rank):
         client = MasterClient(f"localhost:{master.port}", node_id=rank)
         results[rank] = run_network_check(
-            client, node_rank=rank, nproc_per_node=1, timeout=120
+            client, node_rank=rank, nproc_per_node=1, timeout=timeout
         )
 
     threads = [
         threading.Thread(target=check, args=(r,), daemon=True)
-        for r in (0, 1)
+        for r in ranks
     ]
     for t in threads:
         t.start()
     for t in threads:
-        t.join(timeout=180)
-    assert results == {0: True, 1: True}
+        t.join(timeout=timeout + 120)
+    return results
+
+
+def test_two_node_check_all_healthy(make_master):
+    master = make_master(2)
+    assert run_agents(master, (0, 1)) == {0: True, 1: True}
+
+
+def test_rigged_node_isolated_and_evicted(make_master, monkeypatch):
+    """Four real agents; node 1's probe is rigged to fail every round.
+    Round 0 marks it suspect, the bisection round pairs it with a
+    healthy node, and the verdict isolates EXACTLY node 1 — which gets
+    marked broken (BREAKDOWN) for eviction+relaunch while its round-0
+    partner is cleared."""
+    monkeypatch.setenv("DLROVER_TPU_CHAOS_CHECK_FAIL_RANKS", "1")
+    master = make_master(4)
+    results = run_agents(master, (0, 1, 2, 3))
+    assert results == {0: True, 1: False, 2: True, 3: True}
+    client = MasterClient(f"localhost:{master.port}", node_id=0)
+    faults, _, needs_more = client.check_fault_node()
+    assert faults == [1]
+    assert not needs_more
+    # The master recorded the eviction: node 1 is broken hardware.
+    from dlrover_tpu.master.node.job_context import get_job_context
+
+    node = get_job_context().get_node(NodeType.WORKER, 1)
+    assert node is not None and node.status == NodeStatus.BREAKDOWN
+
+
+def test_straggler_detected_e2e(make_master, monkeypatch):
+    """Node 1 completes its probes but far slower than the median: the
+    check passes (no eviction) and the master flags it a straggler."""
+    monkeypatch.setenv("DLROVER_TPU_CHAOS_CHECK_SLOW_RANKS", "1")
+    monkeypatch.setenv("DLROVER_TPU_CHAOS_CHECK_SLOW_SECS", "25")
+    master = make_master(4)
+    results = run_agents(master, (0, 1, 2, 3))
+    assert results == {0: True, 1: True, 2: True, 3: True}
+    client = MasterClient(f"localhost:{master.port}", node_id=0)
+    assert 1 in client.check_straggler()

@@ -1,0 +1,238 @@
+"""Node groups (TPU slice blocks): complete-group rendezvous, the
+intra/inter phased network check, and whole-block relaunch.
+
+Mirrors reference rdzv_manager.py:876 (GroupNodeNetworkCheckRendezvous
+Manager) and dist_job_manager.py:1128 (_relaunch_node_group) coverage.
+"""
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    JobStage,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.elastic_training.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    GroupNetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.testing.sim_cluster import (
+    SimCluster,
+    SimNodeWatcher,
+    SimScaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def join_all(mgr, ranks_groups):
+    for rank, group in ranks_groups:
+        mgr.join_rendezvous(rank, rank, 1, node_group=group)
+
+
+# ---------------------------------------------------------------------------
+# Training rendezvous: complete groups only
+# ---------------------------------------------------------------------------
+
+
+def test_training_rdzv_orders_world_group_major():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(4, 4, waiting_timeout=0.0, node_unit=2)
+    # Join order scrambled across groups; world must come out
+    # group-major so slice hosts are contiguous in rank order.
+    join_all(mgr, [(0, 0), (2, 1), (1, 0), (3, 1)])
+    _, _, world = mgr.get_comm_world(0)
+    assert list(world) == [0, 1, 2, 3]
+
+
+def test_training_rdzv_holds_back_incomplete_block():
+    """Losing a host in block A never tears down block B: the round
+    forms from block B alone while block A waits for its replacement."""
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(2, 4, waiting_timeout=0.0, node_unit=2)
+    # Block 0 is missing rank 1 (host died); block 1 complete.
+    join_all(mgr, [(0, 0), (2, 1), (3, 1)])
+    _, _, world = mgr.get_comm_world(2)
+    assert list(world) == [2, 3], f"incomplete block leaked in: {world}"
+    # Rank 0 is still waiting, not evicted.
+    assert mgr.num_nodes_waiting() == 1
+    # Replacement arrives: next round forms with both blocks.
+    mgr.join_rendezvous(1, 1, 1, node_group=0)
+    _, _, world2 = mgr.get_comm_world(0)
+    assert list(world2) == [0, 1]  # legal size 2 round with block 0
+    # (block 1 already holds a completed round and didn't re-join)
+
+
+def test_training_rdzv_no_round_without_any_complete_block():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(1, 4, waiting_timeout=0.0, node_unit=2)
+    join_all(mgr, [(0, 0), (2, 1)])  # both blocks half-present
+    _, _, world = mgr.get_comm_world(0)
+    assert world == {}
+
+
+# ---------------------------------------------------------------------------
+# Group network check phases
+# ---------------------------------------------------------------------------
+
+
+def make_check_mgr():
+    mgr = GroupNetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(4, 4, waiting_timeout=0.0, node_unit=2)
+    return mgr
+
+
+GROUPS = [(0, 0), (1, 0), (2, 1), (3, 1)]
+
+
+def run_round(mgr, fail_ranks=()):
+    """All agents join, fetch their pair, and report."""
+    join_all(mgr, GROUPS)
+    pairs = {}
+    for rank, _ in GROUPS:
+        _, _, group = mgr.get_comm_world(rank)
+        pairs[rank] = tuple(sorted(group))
+    for rank, _ in GROUPS:
+        mgr.report_network_check_result(
+            rank, rank not in fail_ranks, 1.0 + 0.01 * rank
+        )
+    return pairs
+
+
+def test_clean_intra_then_clean_inter_concludes():
+    mgr = make_check_mgr()
+    pairs = run_round(mgr)
+    # Phase INTRA: pairs stay within slices.
+    assert pairs[0] == (0, 1) and pairs[2] == (2, 3)
+    faults, rnd, needs_more = mgr.check_fault_node()
+    assert (faults, rnd, needs_more) == ([], 0, True)
+    pairs = run_round(mgr)
+    # Phase INTER: same-position hosts across slices (DCN probe).
+    assert pairs[0] == (0, 2) and pairs[1] == (1, 3)
+    faults, rnd, needs_more = mgr.check_fault_node()
+    assert (faults, rnd, needs_more) == ([], 1, False)
+
+
+def test_intra_failure_bisects_within_slice():
+    mgr = make_check_mgr()
+    run_round(mgr, fail_ranks={0, 1})  # block 0's pair fails
+    _, _, needs_more = mgr.check_fault_node()
+    assert needs_more
+    pairs = run_round(mgr, fail_ranks={1})  # diag: only rank 1 fails
+    # A fully-suspect 2-host block degenerates to solo host probes (no
+    # intra-healthy partner exists); the healthy block re-pairs intra.
+    assert pairs[0] == (0,) and pairs[1] == (1,)
+    assert pairs[2] == (2, 3)
+    faults, rnd, needs_more = mgr.check_fault_node()
+    assert faults == [1]
+    assert not needs_more
+
+
+def test_inter_failure_bisects_across_slices():
+    mgr = make_check_mgr()
+    run_round(mgr)  # intra clean
+    run_round(mgr, fail_ranks={0, 2})  # DCN pair (0,2) fails
+    _, _, needs_more = mgr.check_fault_node()
+    assert needs_more
+    # Diag: each suspect pairs with a healthy host of ANOTHER slice.
+    pairs = run_round(mgr, fail_ranks={0})
+    assert pairs[0] == (0, 3)
+    assert pairs[2] == (1, 2)
+    faults, _, needs_more = mgr.check_fault_node()
+    assert faults == [0]
+    assert not needs_more
+
+
+def test_mixed_group_info_falls_back_to_flat_flow():
+    """One host without group info (e.g. rolling upgrade): the whole
+    cycle must run the flat pair/bisect flow and still CONCLUDE."""
+    mgr = make_check_mgr()
+    for rank, group in [(0, 0), (1, 0), (2, 1), (3, -1)]:
+        mgr.join_rendezvous(rank, rank, 1, node_group=group)
+    for rank in range(4):
+        mgr.get_comm_world(rank)
+    for rank in range(4):
+        mgr.report_network_check_result(rank, True, 1.0)
+    faults, _, needs_more = mgr.check_fault_node()
+    assert faults == []
+    assert not needs_more
+
+
+def test_fresh_cycle_after_conclusion():
+    mgr = make_check_mgr()
+    run_round(mgr)
+    run_round(mgr)
+    assert mgr.check_fault_node() == ([], 1, False)
+    # A relaunched node re-joining starts a fresh cycle at INTRA.
+    pairs = run_round(mgr)
+    assert pairs[0] == (0, 1)
+    assert mgr.check_fault_node() == ([], 0, True)
+
+
+# ---------------------------------------------------------------------------
+# Whole-block relaunch
+# ---------------------------------------------------------------------------
+
+
+def make_manager():
+    cluster = SimCluster()
+    mgr = DistributedJobManager(
+        job_name="grp-job",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=4, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        scaler=SimScaler("grp-job", cluster),
+        watcher=SimNodeWatcher("grp-job", cluster),
+        max_relaunch_count=2,
+        node_group_size=2,
+    )
+    get_job_context().set_job_stage(JobStage.RUNNING)
+    for node in mgr.worker_manager.init_nodes():
+        if mgr._node_group_size > 1:
+            node.node_group = node.rank_index // mgr._node_group_size
+        node.update_status(NodeStatus.RUNNING)
+    return mgr
+
+
+def latest_by_rank(mgr):
+    return {n.rank_index: n for n in mgr.worker_manager.latest_nodes()}
+
+def test_hardware_fault_relaunches_whole_block():
+    mgr = make_manager()
+    before = latest_by_rank(mgr)
+    mgr._observe_failure(before[0], NodeExitReason.HARDWARE_ERROR)
+    after = latest_by_rank(mgr)
+    # Block 0 (ranks 0, 1) fully replaced...
+    assert after[0].id != before[0].id
+    assert after[1].id != before[1].id
+    assert after[0].node_group == 0 and after[1].node_group == 0
+    # ...block 1 untouched.
+    assert after[2].id == before[2].id
+    assert after[3].id == before[3].id
+    # The healthy member's old record must not relaunch again when its
+    # deletion event lands.
+    old_rank1 = before[1]
+    old_rank1.update_status(NodeStatus.RUNNING)  # still alive pre-kill
+    mgr._observe_failure(old_rank1, "", status=NodeStatus.DELETED)
+    newest = latest_by_rank(mgr)
+    assert newest[1].id == after[1].id, "double relaunch of block member"
+
+
+def test_software_crash_relaunches_single_node_in_block():
+    mgr = make_manager()
+    before = latest_by_rank(mgr)
+    mgr._observe_failure(before[0], NodeExitReason.SOFTWARE_ERROR)
+    after = latest_by_rank(mgr)
+    assert after[0].id != before[0].id
+    assert after[1].id == before[1].id  # block-mate untouched
