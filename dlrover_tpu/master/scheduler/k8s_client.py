@@ -16,6 +16,7 @@ from dlrover_tpu.common.log import logger
 ELASTICJOB_GROUP = "elastic.iml.github.io"
 ELASTICJOB_VERSION = "v1alpha1"
 SCALEPLAN_PLURAL = "scaleplans"
+ELASTICJOB_PLURAL = "elasticjobs"
 
 
 class K8sApi:
@@ -41,7 +42,32 @@ class K8sApi:
     ) -> bool:
         raise NotImplementedError
 
+    def list_custom_objects(self, namespace: str, plural: str) -> List[Dict]:
+        raise NotImplementedError
+
+    def watch_custom_objects(
+        self, namespace: str, plural: str
+    ) -> Iterator[Dict]:
+        """Yield {"type": ADDED|MODIFIED|DELETED, "object": cr_dict}."""
+        raise NotImplementedError
+
+    def patch_custom_object_status(
+        self, namespace: str, plural: str, name: str, status: Dict
+    ) -> bool:
+        raise NotImplementedError
+
+    def delete_custom_object(
+        self, namespace: str, plural: str, name: str
+    ) -> bool:
+        raise NotImplementedError
+
     def create_service(self, namespace: str, manifest: Dict) -> bool:
+        raise NotImplementedError
+
+    def get_service(self, namespace: str, name: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def delete_service(self, namespace: str, name: str) -> bool:
         raise NotImplementedError
 
 
@@ -110,12 +136,77 @@ class RealK8sApi(K8sApi):
             logger.exception("custom object create failed")
             return False
 
+    def list_custom_objects(self, namespace, plural):
+        try:
+            resp = self._custom.list_namespaced_custom_object(
+                ELASTICJOB_GROUP, ELASTICJOB_VERSION, namespace, plural
+            )
+            return resp.get("items", [])
+        except Exception:
+            logger.exception("custom object list failed")
+            return []
+
+    def watch_custom_objects(self, namespace, plural):
+        w = self._watch.Watch()
+        for event in w.stream(
+            self._custom.list_namespaced_custom_object,
+            ELASTICJOB_GROUP,
+            ELASTICJOB_VERSION,
+            namespace,
+            plural,
+        ):
+            yield {"type": event["type"], "object": event["object"]}
+
+    def patch_custom_object_status(self, namespace, plural, name, status):
+        try:
+            self._custom.patch_namespaced_custom_object_status(
+                ELASTICJOB_GROUP,
+                ELASTICJOB_VERSION,
+                namespace,
+                plural,
+                name,
+                {"status": status},
+            )
+            return True
+        except Exception:
+            logger.warning("status patch failed: %s", name)
+            return False
+
+    def delete_custom_object(self, namespace, plural, name):
+        try:
+            self._custom.delete_namespaced_custom_object(
+                ELASTICJOB_GROUP,
+                ELASTICJOB_VERSION,
+                namespace,
+                plural,
+                name,
+            )
+            return True
+        except Exception:
+            logger.warning("custom object delete failed: %s", name)
+            return False
+
     def create_service(self, namespace, manifest):
         try:
             self._core.create_namespaced_service(namespace, manifest)
             return True
         except Exception:
             logger.exception("service create failed")
+            return False
+
+    def get_service(self, namespace, name):
+        try:
+            svc = self._core.read_namespaced_service(name, namespace)
+            return self._core.api_client.sanitize_for_serialization(svc)
+        except Exception:
+            return None
+
+    def delete_service(self, namespace, name):
+        try:
+            self._core.delete_namespaced_service(name, namespace)
+            return True
+        except Exception:
+            logger.warning("service delete failed: %s", name)
             return False
 
 

@@ -34,8 +34,13 @@ class FakeK8sApi(K8sApi):
     def __init__(self, auto_run: bool = True):
         self.pods = {}
         self.custom_objects = []
+        self.crs = {}  # (plural, name) -> object (reconciler surface)
+        self.services = {}
         self.deleted = []
+        self.deleted_services = []
+        self.status_patches = []
         self.events: "queue.Queue" = queue.Queue()
+        self.cr_events: "queue.Queue" = queue.Queue()
         self.auto_run = auto_run
         self._lock = threading.Lock()
 
@@ -70,9 +75,51 @@ class FakeK8sApi(K8sApi):
 
     def create_custom_object(self, namespace, plural, body):
         self.custom_objects.append((plural, body))
+        name = body.get("metadata", {}).get("name", "")
+        with self._lock:
+            self.crs[(plural, name)] = body
+        self.cr_events.put({"type": "ADDED", "object": body})
+        return True
+
+    def list_custom_objects(self, namespace, plural):
+        with self._lock:
+            return [
+                obj for (p, _), obj in self.crs.items() if p == plural
+            ]
+
+    def watch_custom_objects(self, namespace, plural):
+        while True:
+            event = self.cr_events.get()
+            if event is None:
+                return
+            yield event
+
+    def patch_custom_object_status(self, namespace, plural, name, status):
+        with self._lock:
+            obj = self.crs.get((plural, name))
+            if obj is None:
+                return False
+            obj["status"] = status
+        self.status_patches.append((name, status))
+        return True
+
+    def delete_custom_object(self, namespace, plural, name):
+        with self._lock:
+            obj = self.crs.pop((plural, name), None)
+        if obj is not None:
+            self.cr_events.put({"type": "DELETED", "object": obj})
         return True
 
     def create_service(self, namespace, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+        return True
+
+    def get_service(self, namespace, name):
+        return self.services.get(name)
+
+    def delete_service(self, namespace, name):
+        self.services.pop(name, None)
+        self.deleted_services.append(name)
         return True
 
     # ---- test controls -----------------------------------------------------
@@ -217,6 +264,138 @@ def wait_until(predicate, timeout=5.0, interval=0.02):
             return True
         time.sleep(interval)
     return False
+
+
+# ---- elasticjob reconciler --------------------------------------------------
+
+
+def make_elasticjob(name="ejob", replicas=2, node_unit=0):
+    spec = {
+        "image": "img:1",
+        "masterResource": {"cpu": 2, "memory_mb": 2048},
+        "replicaSpecs": {
+            "worker": {
+                "replicas": replicas,
+                "resource": {"tpu_chips": 4, "tpu_type": "tpu-v5e"},
+            }
+        },
+    }
+    if node_unit:
+        spec["nodeUnit"] = node_unit
+    return {
+        "apiVersion": "elastic.iml.github.io/v1alpha1",
+        "kind": "ElasticJob",
+        "metadata": {"name": name, "uid": "uid-1"},
+        "spec": spec,
+    }
+
+
+def make_reconciler(api):
+    from dlrover_tpu.operator.reconciler import ElasticJobReconciler
+
+    return ElasticJobReconciler(namespace="default", api=api)
+
+
+def test_reconcile_creates_master_pod_and_service():
+    api = FakeK8sApi(auto_run=False)
+    rec = make_reconciler(api)
+    job = make_elasticjob(node_unit=2)
+    rec.reconcile(job)
+    pod = api.pods["ejob-dlrover-master"]
+    cmd = pod["spec"]["containers"][0]["command"]
+    assert "--node_num" in cmd and cmd[cmd.index("--node_num") + 1] == "2"
+    assert "--node_unit" in cmd and cmd[cmd.index("--node_unit") + 1] == "2"
+    assert pod["metadata"]["ownerReferences"][0]["name"] == "ejob"
+    svc = api.services["ejob-dlrover-master"]
+    assert svc["spec"]["selector"]["role"] == "dlrover-master"
+    # Idempotent: a second reconcile creates nothing new.
+    pods_before = dict(api.pods)
+    rec.reconcile(job)
+    assert api.pods == pods_before
+
+
+def test_reconcile_tracks_phases():
+    api = FakeK8sApi(auto_run=False)
+    rec = make_reconciler(api)
+    job = make_elasticjob()
+    api.create_custom_object("default", "elasticjobs", job)
+    rec.reconcile(job)
+    assert api.status_patches[-1][1]["phase"] == "Pending"
+    api.set_phase("ejob-dlrover-master", "Running")
+    # Two worker pods in different phases get counted per phase.
+    for i, phase in ((0, "Running"), (1, "Pending")):
+        api.create_pod(
+            "default",
+            {
+                "metadata": {
+                    "name": f"ejob-worker-{i}",
+                    "labels": {"job-name": "ejob", "node-type": "worker"},
+                },
+                "status": {"phase": phase},
+            },
+        )
+        api.set_phase(f"ejob-worker-{i}", phase)
+    rec.reconcile(job)
+    name, status = api.status_patches[-1]
+    assert name == "ejob"
+    assert status["phase"] == "Running"
+    assert status["replicaStatuses"]["worker"] == {
+        "running": 1,
+        "pending": 1,
+    }
+    # Master pod finished -> job Succeeded.
+    api.set_phase("ejob-dlrover-master", "Succeeded")
+    rec.reconcile(job)
+    assert api.status_patches[-1][1]["phase"] == "Succeeded"
+
+
+def test_service_recreated_when_lost():
+    """A deleted/failed service is recreated on the next pass even
+    though the master pod still exists."""
+    api = FakeK8sApi(auto_run=False)
+    rec = make_reconciler(api)
+    job = make_elasticjob()
+    rec.reconcile(job)
+    assert "ejob-dlrover-master" in api.services
+    api.services.clear()
+    rec.reconcile(job)
+    assert "ejob-dlrover-master" in api.services
+
+
+def test_deleted_job_garbage_collects():
+    api = FakeK8sApi(auto_run=False)
+    rec = make_reconciler(api)
+    job = make_elasticjob()
+    api.create_custom_object("default", "elasticjobs", job)
+    rec.reconcile(job)
+    api.create_pod(
+        "default",
+        {
+            "metadata": {
+                "name": "ejob-worker-0",
+                "labels": {"job-name": "ejob"},
+            },
+            "status": {"phase": "Running"},
+        },
+    )
+    rec.gc_job("ejob")
+    assert "ejob-dlrover-master" in api.deleted
+    assert "ejob-worker-0" in api.deleted
+    assert "ejob-dlrover-master" in api.deleted_services
+
+
+def test_watch_loop_reconciles_and_gcs():
+    api = FakeK8sApi(auto_run=False)
+    rec = make_reconciler(api)
+    rec.start()
+    try:
+        api.create_custom_object("default", "elasticjobs", make_elasticjob())
+        assert wait_until(lambda: "ejob-dlrover-master" in api.pods)
+        api.delete_custom_object("default", "elasticjobs", "ejob")
+        assert wait_until(lambda: "ejob-dlrover-master" in api.deleted)
+    finally:
+        rec.stop()
+        api.cr_events.put(None)
 
 
 def test_job_manager_over_k8s_backend():
