@@ -3,17 +3,27 @@
 Parity: reference dlrover/python/unified/backend (ElasticWorker /
 BaseWorker Ray actors). Ray is not a baked-in dependency, so the
 first-class backend runs each vertex as a local subprocess with role
-coordinates injected via env — the same contract a Ray-actor backend
-implements when ``ray`` is importable (gated in RayBackend.available()).
+coordinates injected via env; RayBackend implements the same contract
+with Ray actors scheduled into STRICT_PACK placement groups when
+``ray`` is importable.
+
+Self-failover support: every started worker writes its exit code to an
+rc-file, and handles serialize to plain records (pid + rc path). A new
+manager incarnation re-attaches to a live pid it did not spawn — the
+process keeps running through the manager restart — and still learns
+the true exit code afterwards from the rc-file.
 """
 
 import abc
 import os
+import shlex
 import signal
 import subprocess
 import sys
+import tempfile
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.unified.config import RoleConfig
@@ -26,13 +36,55 @@ class UnifiedEnv:
     ROLE_WORLD_SIZE = "DLROVER_TPU_ROLE_WORLD_SIZE"
     GROUP_INDEX = "DLROVER_TPU_GROUP_INDEX"
     BUNDLE_ID = "DLROVER_TPU_BUNDLE_ID"
+    NODE_SLOT = "DLROVER_TPU_NODE_SLOT"
     JOB_NAME = "DLROVER_TPU_JOB_NAME"
 
 
 @dataclass
 class WorkerHandle:
     vertex: Vertex
-    process: subprocess.Popen
+    process: Optional[subprocess.Popen] = None
+    pid: int = -1
+    rc_path: str = ""
+    # Ray-backend fields
+    actor: object = None
+    future: object = None
+
+    start_ticks: int = -1  # /proc starttime: guards pid recycling
+    actor_name: str = ""   # Ray backend: named detached actor handle
+
+    def record(self) -> Dict:
+        """Serializable facts a future manager needs to re-attach."""
+        return {
+            "role": self.vertex.role,
+            "rank": self.vertex.rank,
+            "pid": self.pid,
+            "rc_path": self.rc_path,
+            "start_ticks": self.start_ticks,
+            "actor_name": self.actor_name,
+        }
+
+
+def worker_cmd(role: RoleConfig) -> list:
+    if ":" in role.entrypoint:
+        module, fn = role.entrypoint.split(":", 1)
+        code = f"import {module}; {module}.{fn}()"
+        cmd = [sys.executable, "-c", code]
+    else:
+        cmd = [sys.executable, "-m", role.entrypoint]
+    return cmd + role.args
+
+
+def worker_envs(vertex: Vertex, job_name: str) -> Dict[str, str]:
+    return {
+        UnifiedEnv.ROLE: vertex.role,
+        UnifiedEnv.ROLE_RANK: str(vertex.rank),
+        UnifiedEnv.ROLE_WORLD_SIZE: str(vertex.world_size),
+        UnifiedEnv.GROUP_INDEX: str(vertex.group_index),
+        UnifiedEnv.BUNDLE_ID: str(vertex.bundle_id),
+        UnifiedEnv.NODE_SLOT: str(vertex.node_slot),
+        UnifiedEnv.JOB_NAME: job_name,
+    }
 
 
 class Backend(abc.ABC):
@@ -50,58 +102,213 @@ class Backend(abc.ABC):
     def stop_worker(self, handle: WorkerHandle, timeout: float = 10.0):
         ...
 
+    def check_child(self, handle: WorkerHandle) -> Optional[int]:
+        """Health hook beyond process liveness (reference SubMaster
+        check_child); backends may override with deeper probes."""
+        return self.poll(handle)
+
+    def reattach(self, vertex: Vertex, record: Dict) -> Optional[WorkerHandle]:
+        """Adopt a worker a previous manager incarnation started.
+        Returns None when the backend cannot re-attach."""
+        return None
+
 
 class LocalProcessBackend(Backend):
+    def __init__(self, rc_dir: str = ""):
+        self._rc_dir = rc_dir or tempfile.mkdtemp(
+            prefix="dlrover_tpu_unified_rc_"
+        )
+
+    def _rc_path(self, vertex: Vertex, job_name: str) -> str:
+        return os.path.join(
+            self._rc_dir, f"{job_name}-{vertex.name}-{os.getpid()}.rc"
+        )
+
     def start_worker(
         self, vertex: Vertex, role: RoleConfig, job_name: str
     ) -> WorkerHandle:
         env = dict(os.environ)
         env.update(vertex.envs)
-        env.update(
-            {
-                UnifiedEnv.ROLE: vertex.role,
-                UnifiedEnv.ROLE_RANK: str(vertex.rank),
-                UnifiedEnv.ROLE_WORLD_SIZE: str(vertex.world_size),
-                UnifiedEnv.GROUP_INDEX: str(vertex.group_index),
-                UnifiedEnv.BUNDLE_ID: str(vertex.bundle_id),
-                UnifiedEnv.JOB_NAME: job_name,
-            }
-        )
-        if ":" in role.entrypoint:
-            module, fn = role.entrypoint.split(":", 1)
-            code = f"import {module}; {module}.{fn}()"
-            cmd = [sys.executable, "-c", code]
-        else:
-            cmd = [sys.executable, "-m", role.entrypoint]
-        cmd += role.args
+        env.update(worker_envs(vertex, job_name))
+        rc_path = self._rc_path(vertex, job_name)
+        try:
+            os.unlink(rc_path)
+        except FileNotFoundError:
+            pass
+        # Wrap the command so the exit code lands in the rc-file: a
+        # re-attached manager (not the process's parent) can still read
+        # the true exit status after the worker dies.
+        inner = " ".join(shlex.quote(c) for c in worker_cmd(role))
+        cmd = [
+            "/bin/sh",
+            "-c",
+            f'{inner}; rc=$?; echo "$rc" > {shlex.quote(rc_path)}.tmp && '
+            f"mv {shlex.quote(rc_path)}.tmp {shlex.quote(rc_path)}; "
+            f"exit $rc",
+        ]
         proc = subprocess.Popen(cmd, env=env, start_new_session=True)
         logger.info(
             "started %s pid=%d (%s)", vertex.name, proc.pid, role.entrypoint
         )
-        return WorkerHandle(vertex=vertex, process=proc)
+        return WorkerHandle(
+            vertex=vertex,
+            process=proc,
+            pid=proc.pid,
+            rc_path=rc_path,
+            start_ticks=self._proc_start_ticks(proc.pid),
+        )
+
+    @staticmethod
+    def _proc_start_ticks(pid: int) -> int:
+        """Kernel start time of the process: (pid, start_ticks) is a
+        unique process identity, immune to pid recycling."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return int(f.read().rsplit(")", 1)[1].split()[19])
+        except (OSError, IndexError, ValueError):
+            return -1
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        """Liveness that does NOT count zombies: a dead-but-unreaped
+        wrapper (its parent master crashed or hasn't waited) must read
+        as exited."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            return state != "Z"
+        except (OSError, IndexError):
+            try:
+                os.kill(pid, 0)
+                return True
+            except (ProcessLookupError, PermissionError):
+                return False
 
     def poll(self, handle: WorkerHandle) -> Optional[int]:
-        return handle.process.poll()
+        if handle.process is not None:
+            return handle.process.poll()
+        # Re-attached: not our child; liveness via /proc, exit code via
+        # the rc-file the wrapper wrote.
+        if self._pid_alive(handle.pid):
+            return None
+        return self._read_rc(handle)
+
+    def _read_rc(self, handle: WorkerHandle) -> int:
+        try:
+            with open(handle.rc_path) as f:
+                return int(f.read().strip() or "1")
+        except (OSError, ValueError):
+            # Died without writing (SIGKILL of the wrapper): failure.
+            return 1
 
     def stop_worker(self, handle: WorkerHandle, timeout: float = 10.0):
-        if handle.process.poll() is not None:
+        if self.poll(handle) is not None:
             return
         try:
-            os.killpg(handle.process.pid, signal.SIGTERM)
+            os.killpg(handle.pid, signal.SIGTERM)
         except ProcessLookupError:
             return
-        try:
-            handle.process.wait(timeout)
-        except subprocess.TimeoutExpired:
+        if handle.process is not None:
             try:
-                os.killpg(handle.process.pid, signal.SIGKILL)
-            except ProcessLookupError:
+                handle.process.wait(timeout)
+                return
+            except subprocess.TimeoutExpired:
                 pass
+        else:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if self.poll(handle) is not None:
+                    return
+                time.sleep(0.1)
+        try:
+            os.killpg(handle.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        if handle.process is not None:
             handle.process.wait()
+
+    def reattach(self, vertex: Vertex, record: Dict) -> Optional[WorkerHandle]:
+        pid = record.get("pid", -1)
+        rc_path = record.get("rc_path", "")
+        if pid <= 0:
+            return None
+        handle = WorkerHandle(
+            vertex=vertex, process=None, pid=pid, rc_path=rc_path
+        )
+        # The rc-file is authoritative: if it exists the worker already
+        # exited, whatever now occupies the pid.
+        if rc_path and os.path.exists(rc_path):
+            return handle
+        if self._pid_alive(pid):
+            # Guard against a recycled pid: the kernel start time must
+            # match the one recorded at spawn.
+            recorded = record.get("start_ticks", -1)
+            if recorded >= 0 and self._proc_start_ticks(pid) != recorded:
+                logger.warning(
+                    "pid %d was recycled (start time mismatch); not "
+                    "adopting it for %s",
+                    pid,
+                    vertex.name,
+                )
+                return None
+            logger.info("re-attached %s pid=%d", vertex.name, pid)
+            handle.start_ticks = recorded
+            return handle
+        return None
+
+
+class UnifiedWorkerActor:
+    """Body of the detached Ray worker actor (wrapped by ``ray.remote``
+    at backend init). Detached + named so a restarted PrimeManager
+    re-attaches with ``ray.get_actor`` instead of starting a duplicate;
+    ``start`` is idempotent for the same reason."""
+
+    def __init__(self):
+        import threading
+
+        self._proc = None
+        self._lock = threading.Lock()
+
+    def start(self, cmd, env):
+        with self._lock:
+            if self._proc is not None:
+                return False  # re-attach must not respawn
+            merged = dict(os.environ)
+            merged.update(env)
+            self._proc = subprocess.Popen(
+                cmd, env=merged, start_new_session=True
+            )
+            return True
+
+    def poll(self):
+        with self._lock:
+            if self._proc is None:
+                return None
+            return self._proc.poll()
+
+    def stop(self, timeout=10.0):
+        with self._lock:
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+            proc.wait(timeout)
+        except Exception:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except Exception:
+                pass
 
 
 class RayBackend(Backend):
-    """Ray-actor backend; only constructible when ray is installed."""
+    """Ray backend: one NAMED DETACHED actor per vertex, scheduled into
+    the STRICT_PACK placement group of its node slot (reference
+    unified/controller/schedule/scheduler.py + backend actors). The
+    detached-actor identity is what makes manager self-failover work on
+    Ray: a new manager re-attaches with ``ray.get_actor`` and the worker
+    process is never disturbed. Constructible only when ``ray`` is
+    installed."""
 
     @staticmethod
     def available() -> bool:
@@ -112,21 +319,104 @@ class RayBackend(Backend):
         except ImportError:
             return False
 
-    def __init__(self):
+    def __init__(self, placement=None):
         if not self.available():
             raise ImportError(
                 "ray is not installed; use LocalProcessBackend"
             )
-        raise NotImplementedError(
-            "RayBackend is a deployment-time extension point; the "
-            "process contract matches LocalProcessBackend"
-        )
+        import ray
+
+        self._ray = ray
+        if not ray.is_initialized():
+            ray.init(ignore_reinit_error=True)
+        self._actor_cls = ray.remote(UnifiedWorkerActor)
+        self._placement = placement
+        self._groups: Dict[int, object] = {}
+
+    def _group_for(self, vertex: Vertex):
+        """One placement group per node slot with one bundle per
+        collocation bundle, sized from the scheduler's per-bundle
+        aggregates (STRICT_PACK keeps collocated roles on one node)."""
+        if self._placement is None or vertex.node_slot < 0:
+            return None, None
+        slot = vertex.node_slot
+        if slot not in self._groups:
+            slot_info = self._placement.slots[slot]
+            bundle_res = []
+            for bundle_id in slot_info.bundles or [0]:
+                res = slot_info.bundle_resources.get(bundle_id, {})
+                bundle_res.append({"CPU": max(res.get("cpu", 1), 1)})
+            pg = self._ray.util.placement_group(
+                bundle_res, strategy="STRICT_PACK"
+            )
+            self._ray.get(pg.ready())
+            self._groups[slot] = pg
+        pg = self._groups[slot]
+        slot_info = self._placement.slots[slot]
+        bundle_index = slot_info.bundles.index(vertex.bundle_id)
+        return pg, bundle_index
+
+    def _actor_name(self, vertex: Vertex, job_name: str) -> str:
+        return f"{job_name}-{vertex.name}"
 
     def start_worker(self, vertex, role, job_name):
-        raise NotImplementedError
+        ray = self._ray
+        name = self._actor_name(vertex, job_name)
+        env = dict(vertex.envs)
+        env.update(worker_envs(vertex, job_name))
+        options = {
+            "name": name,
+            "lifetime": "detached",
+            "get_if_exists": True,
+            "num_cpus": role.resource.get("cpu", 1),
+        }
+        pg, bundle_index = self._group_for(vertex)
+        if pg is not None:
+            options["scheduling_strategy"] = (
+                ray.util.scheduling_strategies.PlacementGroupSchedulingStrategy(  # noqa: E501
+                    placement_group=pg,
+                    placement_group_bundle_index=bundle_index,
+                )
+            )
+        actor = self._actor_cls.options(**options).remote()
+        ray.get(actor.start.remote(worker_cmd(role), env))
+        logger.info("started ray worker actor %s", name)
+        return WorkerHandle(vertex=vertex, actor=actor, actor_name=name)
 
     def poll(self, handle):
-        raise NotImplementedError
+        try:
+            return self._ray.get(handle.actor.poll.remote(), timeout=30)
+        except Exception:
+            logger.warning(
+                "ray actor %s unreachable; reporting failed",
+                handle.actor_name,
+            )
+            return 1
 
-    def stop_worker(self, handle, timeout=10.0):
-        raise NotImplementedError
+    def stop_worker(self, handle, timeout: float = 10.0):
+        try:
+            self._ray.get(
+                handle.actor.stop.remote(timeout), timeout=timeout + 30
+            )
+            self._ray.kill(handle.actor)
+        except Exception:
+            logger.warning("ray actor stop failed", exc_info=True)
+
+    def reattach(self, vertex, record):
+        name = record.get("actor_name", "")
+        if not name:
+            return None
+        try:
+            actor = self._ray.get_actor(name)
+        except Exception:
+            return None
+        logger.info("re-attached ray worker actor %s", name)
+        return WorkerHandle(vertex=vertex, actor=actor, actor_name=name)
+
+
+def create_backend(name: str = "auto", **kwargs) -> Backend:
+    """auto -> Ray when installed, else local subprocesses."""
+    if name == "ray" or (name == "auto" and RayBackend.available()):
+        return RayBackend(**kwargs)
+    kwargs.pop("placement", None)
+    return LocalProcessBackend(**kwargs)

@@ -58,6 +58,12 @@ class RoleBuilder:
         self._role.max_restarts = n
         return self
 
+    def elastic(self) -> "RoleBuilder":
+        """Mark as an elastic data-parallel role (gang world
+        re-formation on membership change; ElasticSubMaster)."""
+        self._role.sub_master = "elastic"
+        return self
+
     def add(self) -> "DLJobBuilder":
         self._parent._roles.append(self._role)
         return self._parent
@@ -82,6 +88,23 @@ class DLJobBuilder:
     def train(self, entrypoint: str) -> RoleBuilder:
         """Shorthand: the conventional 'trainer' role."""
         return self.role("trainer").run(entrypoint)
+
+    # ---- RL role sugar (reference api/builder/rl.py) -----------------
+
+    def actor(self, entrypoint: str) -> RoleBuilder:
+        return self.role("actor").run(entrypoint)
+
+    def rollout(self, entrypoint: str) -> RoleBuilder:
+        return self.role("rollout").run(entrypoint)
+
+    def reward(self, entrypoint: str) -> RoleBuilder:
+        return self.role("reward").run(entrypoint)
+
+    def critic(self, entrypoint: str) -> RoleBuilder:
+        return self.role("critic").run(entrypoint)
+
+    def reference(self, entrypoint: str) -> RoleBuilder:
+        return self.role("reference").run(entrypoint)
 
     def with_collocation(self, *role_names: str) -> "DLJobBuilder":
         self._collocations.append(list(role_names))

@@ -26,6 +26,9 @@ class RoleConfig:
     # restarts every role; "ignore" lets the process die.
     failover_level: str = "role"
     max_restarts: int = 3
+    # SubMaster flavor: "default" supervises processes; "elastic" marks
+    # an elastic data-parallel role (gang world re-formation semantics).
+    sub_master: str = "default"
 
     def validate(self):
         if not self.name:
@@ -43,6 +46,10 @@ class RoleConfig:
             raise ValueError(
                 f"role {self.name}: bad failover level "
                 f"{self.failover_level!r}"
+            )
+        if self.sub_master not in ("default", "elastic"):
+            raise ValueError(
+                f"role {self.name}: bad sub_master {self.sub_master!r}"
             )
 
 

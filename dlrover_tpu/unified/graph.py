@@ -20,6 +20,7 @@ class Vertex:
     world_size: int  # role total
     group_index: int  # which group (bundle) this vertex belongs to
     bundle_id: int = -1
+    node_slot: int = -1  # assigned by unified/scheduler.py
     envs: Dict[str, str] = field(default_factory=dict)
 
     @property

@@ -1,9 +1,15 @@
 """PrimeManager: the unified job's state machine + failover.
 
 Parity: reference dlrover/python/unified/controller/manager.py:88-797
-(PrimeManager: INIT/READY/RUNNING/STOPPING FSM; prepare -> create
-actors -> start; per-role / job-level failover; state persisted to a
-MasterStateBackend for master self-failover).
+(PrimeManager: INIT/READY/RUNNING/STOPPING FSM; prepare -> schedule ->
+create workers -> start; per-role SubMasters with check_child; job-level
+failover; state persisted to a MasterStateBackend so a restarted manager
+re-attaches to LIVE workers instead of killing the job).
+
+Division of labor: each role's SubMaster (unified/submaster.py) owns
+launch/supervision/gang-restart within its budget; the PrimeManager owns
+scheduling (gang placement via unified/scheduler.py), job-level
+failover, persistence, and terminal stages.
 """
 
 import threading
@@ -11,12 +17,18 @@ import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.unified.backend import Backend, LocalProcessBackend, WorkerHandle
+from dlrover_tpu.unified.backend import Backend, LocalProcessBackend
 from dlrover_tpu.unified.config import DLJobConfig
 from dlrover_tpu.unified.graph import ExecutionGraph, build_execution_graph
+from dlrover_tpu.unified.scheduler import Placement, schedule
 from dlrover_tpu.unified.state_backend import (
     MasterStateBackend,
     build_state_backend,
+)
+from dlrover_tpu.unified.submaster import (
+    RoleStatus,
+    SubMaster,
+    create_submaster,
 )
 
 
@@ -36,6 +48,7 @@ class PrimeManager:
         backend: Optional[Backend] = None,
         state_backend: Optional[MasterStateBackend] = None,
         monitor_interval_s: float = 0.5,
+        node_capacity: Optional[Dict[str, float]] = None,
     ):
         config.validate()
         self.config = config
@@ -44,67 +57,91 @@ class PrimeManager:
             config.master_state_path
         )
         self.graph: ExecutionGraph = build_execution_graph(config)
+        self.placement: Placement = schedule(
+            self.graph, config, node_capacity
+        )
         self.stage = JobStage.INIT
-        self._handles: Dict[str, WorkerHandle] = {}
-        self._role_restarts: Dict[str, int] = {
-            r.name: 0 for r in config.roles
+        self.submasters: Dict[str, SubMaster] = {
+            role.name: create_submaster(
+                role,
+                self.graph.by_role(role.name),
+                self.backend,
+                config.job_name,
+            )
+            for role in config.roles
         }
         self._job_restarts = 0
         self._monitor_interval_s = monitor_interval_s
         self._stopped = threading.Event()
         self._lock = threading.Lock()
-        self._restore_state()
+        self._restored_state = self.state_backend.load() or {}
 
     # ---- persistence --------------------------------------------------------
 
     def _persist(self):
-        self.state_backend.save(
-            {
-                "stage": self.stage,
-                "role_restarts": self._role_restarts,
-                "job_restarts": self._job_restarts,
-            }
-        )
-
-    def _restore_state(self):
-        state = self.state_backend.load()
-        if state:
-            self._role_restarts.update(state.get("role_restarts", {}))
-            self._job_restarts = state.get("job_restarts", 0)
-            logger.info(
-                "restored manager state: restarts=%s", self._role_restarts
-            )
+        state = {
+            "stage": self.stage,
+            "role_restarts": {
+                name: sm.restarts
+                for name, sm in self.submasters.items()
+            },
+            "job_restarts": self._job_restarts,
+            "workers": {
+                name: sm.worker_records()
+                for name, sm in self.submasters.items()
+            },
+        }
+        # The supervision loop ticks twice a second; only actual state
+        # changes hit the backend.
+        if state != getattr(self, "_last_saved", None):
+            self.state_backend.save(state)
+            self._last_saved = state
 
     # ---- lifecycle ----------------------------------------------------------
 
     def prepare(self):
-        """INIT -> READY (graph built, backend warm)."""
+        """INIT -> READY (graph built, placement validated).
+
+        Deliberately does NOT persist: overwriting a previous
+        incarnation's RUNNING state with READY before re-attachment
+        would lose the worker records a third incarnation needs if this
+        one crashes mid-start."""
         if self.stage != JobStage.INIT:
             return
         self.stage = JobStage.READY
-        self._persist()
 
     def start(self):
-        """READY -> RUNNING: launch every vertex."""
+        """READY -> RUNNING.
+
+        Self-failover: when the persisted state says a previous manager
+        incarnation was RUNNING, adopt its live workers instead of
+        launching doubles — the job survives a master restart without
+        losing a single worker (reference manager self-failover from the
+        state backend).
+        """
         if self.stage not in (JobStage.INIT, JobStage.READY):
             raise RuntimeError(f"cannot start from stage {self.stage}")
         self.prepare()
+        prev = self._restored_state
+        resuming = prev.get("stage") == JobStage.RUNNING
         with self._lock:
-            for vertex in self.graph.vertices:
-                self._launch(vertex)
+            for name, sm in self.submasters.items():
+                sm.restarts = prev.get("role_restarts", {}).get(name, 0)
+                if resuming:
+                    sm.reattach_or_launch(
+                        prev.get("workers", {}).get(name, {})
+                    )
+                else:
+                    sm.launch_all()
+            self._job_restarts = prev.get("job_restarts", 0)
         self.stage = JobStage.RUNNING
         self._persist()
         logger.info(
-            "unified job %s running: %d workers across %d roles",
+            "unified job %s %s: %d workers across %d roles",
             self.config.job_name,
+            "resumed" if resuming else "running",
             len(self.graph.vertices),
             len(self.config.roles),
-        )
-
-    def _launch(self, vertex):
-        role = self.config.role(vertex.role)
-        self._handles[vertex.name] = self.backend.start_worker(
-            vertex, role, self.config.job_name
         )
 
     # ---- supervision --------------------------------------------------------
@@ -123,75 +160,27 @@ class PrimeManager:
 
     def _tick(self) -> bool:
         with self._lock:
-            exited: Dict[str, int] = {}
-            for name, handle in self._handles.items():
-                code = self.backend.poll(handle)
-                if code is not None:
-                    exited[name] = code
-            failures = {n: c for n, c in exited.items() if c != 0}
-            if failures:
-                return self._handle_failures(failures)
-            if len(exited) == len(self._handles):
+            statuses: Dict[str, Optional[str]] = {}
+            for name, sm in self.submasters.items():
+                statuses[name] = sm.check_children()
+            failed = [
+                n for n, s in statuses.items() if s == RoleStatus.FAILED
+            ]
+            if failed:
+                if any(self.submasters[n].escalates_to_job for n in failed):
+                    return self._job_failover()
+                logger.error(
+                    "roles %s failed beyond their budgets; failing job",
+                    failed,
+                )
+                self._fail()
+                return True
+            self._persist()
+            if all(s == RoleStatus.SUCCEEDED for s in statuses.values()):
                 self.stage = JobStage.SUCCEEDED
                 self._persist()
                 return True
             return False
-
-    def _handle_failures(self, failures: Dict[str, int]) -> bool:
-        failed_roles = sorted(
-            {self._vertex_of(n).role for n in failures}
-        )
-        logger.warning(
-            "unified workers failed: %s (roles %s)",
-            failures,
-            failed_roles,
-        )
-        # Strongest failover level among the failed roles wins.
-        levels = {
-            self.config.role(r).failover_level for r in failed_roles
-        }
-        if "job" in levels:
-            return self._job_failover()
-        for role_name in failed_roles:
-            role = self.config.role(role_name)
-            if role.failover_level == "ignore":
-                # Drop the dead handles: an ignored role's crash must not
-                # keep re-entering failure handling or block success.
-                for name in list(failures):
-                    if self._vertex_of(name).role == role_name:
-                        logger.info(
-                            "ignoring failed worker %s (failover=ignore)",
-                            name,
-                        )
-                        del self._handles[name]
-                continue
-            if self._role_restarts[role_name] >= role.max_restarts:
-                logger.error(
-                    "role %s exhausted %d restarts; failing job",
-                    role_name,
-                    role.max_restarts,
-                )
-                self._fail()
-                return True
-            self._role_restarts[role_name] += 1
-            self._restart_role(role_name)
-        self._persist()
-        if not self._handles:
-            # Every worker was an ignored failure: nothing left to run.
-            self.stage = JobStage.SUCCEEDED
-            self._persist()
-            return True
-        return False
-
-    def _restart_role(self, role_name: str):
-        """Stop + relaunch every vertex of the role (gang restart, the
-        reference's per-role failover)."""
-        logger.info("restarting role %s (gang)", role_name)
-        for vertex in self.graph.by_role(role_name):
-            handle = self._handles.get(vertex.name)
-            if handle is not None:
-                self.backend.stop_worker(handle)
-            self._launch(vertex)
 
     def _job_failover(self) -> bool:
         role_budget = max(r.max_restarts for r in self.config.roles)
@@ -204,10 +193,8 @@ class PrimeManager:
             "job-level failover #%d: restarting all roles",
             self._job_restarts,
         )
-        for handle in self._handles.values():
-            self.backend.stop_worker(handle)
-        for vertex in self.graph.vertices:
-            self._launch(vertex)
+        for sm in self.submasters.values():
+            sm.gang_restart()
         self._persist()
         return False
 
@@ -215,9 +202,6 @@ class PrimeManager:
         self.stage = JobStage.FAILED
         self._persist()
         self._stop_all()
-
-    def _vertex_of(self, name: str):
-        return self._handles[name].vertex
 
     # ---- stop ---------------------------------------------------------------
 
@@ -232,8 +216,5 @@ class PrimeManager:
             self._persist()
 
     def _stop_all(self):
-        for handle in self._handles.values():
-            try:
-                self.backend.stop_worker(handle)
-            except Exception:
-                logger.warning("worker stop failed", exc_info=True)
+        for sm in self.submasters.values():
+            sm.stop_all()

@@ -1,0 +1,195 @@
+"""Per-role SubMasters.
+
+Parity: reference dlrover/python/unified/backend/elastic/master.py:54
+(per-role SubMaster actors with ``check_child``) — each role's workers
+are owned by a SubMaster that launches them, health-checks them through
+the backend's ``check_child`` hook, and applies the role's failover
+policy (gang restart within its restart budget). The PrimeManager
+orchestrates SubMasters and keeps only job-level concerns (job
+failover, persistence, success).
+
+The ElasticSubMaster adds membership awareness for elastic roles: a
+worker lost mid-run triggers a GANG restart of the role (JAX worlds are
+re-formed whole, matching the elastic agent's re-mesh semantics) rather
+than a single-process respawn.
+"""
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.backend import Backend, WorkerHandle
+from dlrover_tpu.unified.config import RoleConfig
+from dlrover_tpu.unified.graph import Vertex
+
+
+class RoleStatus:
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+class SubMaster:
+    def __init__(
+        self,
+        role: RoleConfig,
+        vertices: List[Vertex],
+        backend: Backend,
+        job_name: str,
+    ):
+        self.role = role
+        self.vertices = vertices
+        self.backend = backend
+        self.job_name = job_name
+        self.restarts = 0
+        self.handles: Dict[str, WorkerHandle] = {}
+        self._done: Dict[str, int] = {}
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def launch_all(self):
+        for vertex in self.vertices:
+            self._launch(vertex)
+
+    def _launch(self, vertex: Vertex):
+        self.handles[vertex.name] = self.backend.start_worker(
+            vertex, self.role, self.job_name
+        )
+
+    def reattach_or_launch(self, records: Dict[str, Dict]):
+        """Self-failover path: adopt live workers from a previous
+        manager incarnation; relaunch only the missing/dead-without-
+        trace ones. Running workers are NOT disturbed."""
+        for vertex in self.vertices:
+            record = records.get(vertex.name)
+            handle = (
+                self.backend.reattach(vertex, record) if record else None
+            )
+            if handle is not None:
+                self.handles[vertex.name] = handle
+            else:
+                logger.info(
+                    "no live worker to adopt for %s; launching fresh",
+                    vertex.name,
+                )
+                self._launch(vertex)
+
+    def stop_all(self):
+        for handle in self.handles.values():
+            try:
+                self.backend.stop_worker(handle)
+            except Exception:
+                logger.warning("worker stop failed", exc_info=True)
+
+    # ---- supervision -------------------------------------------------------
+
+    def check_children(self) -> Optional[str]:
+        """Poll every child (through the backend's check_child hook).
+        Returns a RoleStatus transition or None while healthy/running.
+        Restarts within budget are handled HERE; an exhausted budget
+        reports FAILED for the manager's failover policy to resolve."""
+        failures: Dict[str, int] = {}
+        for name, handle in list(self.handles.items()):
+            if name in self._done:
+                continue
+            code = self.backend.check_child(handle)
+            if code is None:
+                continue
+            if code == 0:
+                self._done[name] = 0
+            else:
+                failures[name] = code
+        if failures:
+            if self.role.failover_level == "ignore":
+                for name in failures:
+                    logger.info(
+                        "ignoring failed worker %s (failover=ignore)", name
+                    )
+                    self._done[name] = failures[name]
+            elif self.role.failover_level == "job":
+                return RoleStatus.FAILED  # escalate: manager restarts job
+            else:
+                if self.restarts >= self.role.max_restarts:
+                    logger.error(
+                        "role %s exhausted %d restarts",
+                        self.role.name,
+                        self.role.max_restarts,
+                    )
+                    return RoleStatus.FAILED
+                self.restarts += 1
+                self.gang_restart()
+                return None
+        if len(self._done) == len(self.handles):
+            return RoleStatus.SUCCEEDED
+        return None
+
+    def gang_restart(self):
+        """Stop + relaunch the WHOLE role: elastic JAX worlds re-form
+        whole (a lone respawned process would rejoin a dead world)."""
+        logger.info(
+            "gang restart of role %s (#%d)", self.role.name, self.restarts
+        )
+        self.stop_all()
+        self._done.clear()
+        for vertex in self.vertices:
+            self._launch(vertex)
+
+    def worker_records(self) -> Dict[str, Dict]:
+        return {
+            name: handle.record()
+            for name, handle in self.handles.items()
+        }
+
+    @property
+    def escalates_to_job(self) -> bool:
+        return self.role.failover_level == "job"
+
+
+class ElasticSubMaster(SubMaster):
+    """SubMaster for elastic data-parallel roles: a membership change
+    ALWAYS re-forms the world whole (gang), never a solo respawn — a
+    lone respawned process would rejoin a dead JAX world. This is the
+    subprocess analogue of the reference's elastic SubMaster which
+    re-runs its embedded rendezvous."""
+
+    def reattach_or_launch(self, records: Dict[str, Dict]):
+        """Self-failover: adopt the role only if EVERY member is still
+        alive; one dead member means the world is gone, so the adopted
+        survivors are stopped and the whole role relaunches."""
+        adopted: Dict[str, WorkerHandle] = {}
+        whole = True
+        for vertex in self.vertices:
+            record = records.get(vertex.name)
+            handle = (
+                self.backend.reattach(vertex, record) if record else None
+            )
+            if handle is None or self.backend.poll(handle) is not None:
+                whole = False
+            if handle is not None:
+                adopted[vertex.name] = handle
+        if whole and len(adopted) == len(self.vertices):
+            self.handles = adopted
+            return
+        logger.info(
+            "elastic role %s lost members while the master was down; "
+            "gang-relaunching the whole world",
+            self.role.name,
+        )
+        for handle in adopted.values():
+            try:
+                self.backend.stop_worker(handle)
+            except Exception:
+                logger.warning("worker stop failed", exc_info=True)
+        self.handles.clear()
+        self._done.clear()
+        self.launch_all()
+
+
+def create_submaster(
+    role: RoleConfig,
+    vertices: List[Vertex],
+    backend: Backend,
+    job_name: str,
+) -> SubMaster:
+    if role.sub_master == "elastic":
+        return ElasticSubMaster(role, vertices, backend, job_name)
+    return SubMaster(role, vertices, backend, job_name)

@@ -162,12 +162,15 @@ def test_state_backend_survives_manager_restart(tmp_path, monkeypatch):
         .build()
     )
     manager = PrimeManager(job, state_backend=FileStateBackend(state_path))
-    manager._role_restarts["trainer"] = 2
+    manager.submasters["trainer"].restarts = 2
     manager._persist()
 
-    # A new master over the same state file resumes the budget.
+    # A new master over the same state file resumes the budget when it
+    # starts (and completes, since the worker is a quick no-op).
     manager2 = PrimeManager(job, state_backend=FileStateBackend(state_path))
-    assert manager2._role_restarts["trainer"] == 2
+    manager2.start()
+    assert manager2.submasters["trainer"].restarts == 2
+    assert manager2.wait(timeout=30) == JobStage.SUCCEEDED
 
 
 def test_ignore_role_failure_does_not_fail_job(tmp_path, monkeypatch):
@@ -185,3 +188,216 @@ def test_ignore_role_failure_does_not_fail_job(tmp_path, monkeypatch):
     )
     master = submit(job)
     assert master.status() == JobStage.SUCCEEDED
+
+
+# ---- gang placement (unified/scheduler.py) ----------------------------------
+
+
+def test_scheduler_packs_collocated_bundles():
+    from dlrover_tpu.unified.scheduler import schedule
+
+    job = (
+        DLJobBuilder()
+        .nnodes(2)
+        .role("trainer").run("m.t").total(4).per_group(2).add()
+        .role("rollout").run("m.r").total(2).per_group(1).add()
+        .with_collocation("trainer", "rollout")
+        .build()
+    )
+    graph = build_execution_graph(job)
+    placement = schedule(graph, job)
+    # Collocated trainer group 0 + rollout 0 share a bundle => one slot.
+    t0 = [v for v in graph.by_role("trainer") if v.group_index == 0]
+    r0 = [v for v in graph.by_role("rollout") if v.group_index == 0]
+    slots = {v.node_slot for v in t0 + r0}
+    assert len(slots) == 1
+    # Both node slots are used across the two groups.
+    assert {v.node_slot for v in graph.vertices} == {0, 1}
+    assert placement.slot_of(t0[0].bundle_id) == t0[0].node_slot
+
+
+def test_scheduler_rejects_infeasible_capacity():
+    from dlrover_tpu.unified.scheduler import schedule
+
+    job = (
+        DLJobBuilder()
+        .nnodes(1)
+        .role("a").run("m.a").resource(tpu_chips=4).add()
+        .role("b").run("m.b").resource(tpu_chips=4).add()
+        .with_collocation("a", "b")
+        .build()
+    )
+    graph = build_execution_graph(job)
+    with pytest.raises(ValueError, match="tpu_chips"):
+        schedule(graph, job, node_capacity={"tpu_chips": 4})
+
+
+# ---- manager self-failover (live-worker adoption) ---------------------------
+
+
+def test_manager_self_failover_adopts_live_workers(tmp_path, monkeypatch):
+    """Master dies mid-job; a new incarnation over the same state file
+    re-attaches to the RUNNING workers (same pids, no kill/relaunch) and
+    the job still succeeds (reference manager.py self-failover)."""
+    flag = tmp_path / "release.flag"
+    moddir, mod = _write_worker(
+        tmp_path,
+        "waiter",
+        "import os, time\n"
+        "rank = os.environ['DLROVER_TPU_ROLE_RANK']\n"
+        "open(os.environ['OUT'] + '.pid' + rank, 'w')"
+        ".write(str(os.getpid()))\n"
+        f"while not os.path.exists({str(flag)!r}):\n"
+        "    time.sleep(0.05)\n",
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    out = str(tmp_path / "out")
+    monkeypatch.setenv("OUT", out)
+    state_path = str(tmp_path / "state.json")
+    job = (
+        DLJobBuilder("failover-job")
+        .role("trainer").run(mod).total(2).add()
+        .master_state(state_path)
+        .build()
+    )
+
+    m1 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m1.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if os.path.exists(out + ".pid0") and os.path.exists(out + ".pid1"):
+            break
+        time.sleep(0.05)
+    worker_pids = {
+        r: int(open(out + f".pid{r}").read()) for r in ("0", "1")
+    }
+    handle_pids = {
+        name: h.pid
+        for name, h in m1.submasters["trainer"].handles.items()
+    }
+    # The master "dies": its object goes away WITHOUT stopping workers.
+
+    m2 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m2.start()
+    adopted = {
+        name: h.pid
+        for name, h in m2.submasters["trainer"].handles.items()
+    }
+    assert adopted == handle_pids, "self-failover must adopt, not relaunch"
+    # The actual worker processes were never disturbed.
+    for pid in worker_pids.values():
+        os.kill(pid, 0)  # raises if the worker died
+    flag.write_text("go")
+    assert m2.wait(timeout=30) == JobStage.SUCCEEDED
+
+
+def test_manager_self_failover_relaunches_dead_worker(
+    tmp_path, monkeypatch
+):
+    """Adoption handles the mixed case: one worker died while the master
+    was down -> only that one is relaunched, the live one is kept."""
+    flag = tmp_path / "release2.flag"
+    moddir, mod = _write_worker(
+        tmp_path,
+        "waiter2",
+        "import os, time\n"
+        "rank = os.environ['DLROVER_TPU_ROLE_RANK']\n"
+        "open(os.environ['OUT2'] + '.pid' + rank + '.' + str(os.getpid()),"
+        " 'w').write('')\n"
+        f"while not os.path.exists({str(flag)!r}):\n"
+        "    time.sleep(0.05)\n",
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    out = str(tmp_path / "out2")
+    monkeypatch.setenv("OUT2", out)
+    state_path = str(tmp_path / "state2.json")
+    job = (
+        DLJobBuilder("failover-job2")
+        .role("trainer").run(mod).total(2).add()
+        .master_state(state_path)
+        .build()
+    )
+    m1 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m1.start()
+
+    import glob
+    import signal as _signal
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if len(glob.glob(out + ".pid*")) == 2:
+            break
+        time.sleep(0.05)
+    # Kill worker rank 1 while the master is "down".
+    h1 = m1.submasters["trainer"].handles["trainer-1"]
+    os.killpg(h1.pid, _signal.SIGKILL)
+    h1.process.wait()
+
+    m2 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m2.start()
+    handles = m2.submasters["trainer"].handles
+    assert handles["trainer-0"].pid == m1.submasters["trainer"].handles[
+        "trainer-0"
+    ].pid
+    assert handles["trainer-1"].pid != h1.pid
+    flag.write_text("go")
+    assert m2.wait(timeout=30) == JobStage.SUCCEEDED
+
+
+def test_ray_backend_gated():
+    from dlrover_tpu.unified.backend import RayBackend, create_backend
+
+    if RayBackend.available():  # pragma: no cover - ray not in CI image
+        pytest.skip("ray installed; covered by ray deployment tests")
+    with pytest.raises(ImportError):
+        RayBackend()
+    from dlrover_tpu.unified.backend import LocalProcessBackend
+
+    assert isinstance(create_backend("auto"), LocalProcessBackend)
+
+
+def test_elastic_role_gang_relaunches_on_partial_adoption(
+    tmp_path, monkeypatch
+):
+    """Elastic role + master restart with one dead member: the world
+    re-forms WHOLE — survivors are not adopted solo."""
+    flag = tmp_path / "release3.flag"
+    moddir, mod = _write_worker(
+        tmp_path,
+        "waiter3",
+        "import os, time\n"
+        f"while not os.path.exists({str(flag)!r}):\n"
+        "    time.sleep(0.05)\n",
+    )
+    monkeypatch.setenv("PYTHONPATH", moddir + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+    state_path = str(tmp_path / "state3.json")
+    job = (
+        DLJobBuilder("elastic-failover")
+        .role("trainer").run(mod).total(2).elastic().add()
+        .master_state(state_path)
+        .build()
+    )
+    m1 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m1.start()
+    import signal as _signal
+
+    pids1 = {
+        name: h.pid for name, h in m1.submasters["trainer"].handles.items()
+    }
+    h1 = m1.submasters["trainer"].handles["trainer-1"]
+    os.killpg(h1.pid, _signal.SIGKILL)
+    h1.process.wait()
+
+    m2 = PrimeManager(job, state_backend=FileStateBackend(state_path))
+    m2.start()
+    pids2 = {
+        name: h.pid for name, h in m2.submasters["trainer"].handles.items()
+    }
+    # BOTH members are fresh: the survivor was not adopted solo.
+    assert pids2["trainer-0"] != pids1["trainer-0"]
+    assert pids2["trainer-1"] != pids1["trainer-1"]
+    flag.write_text("go")
+    assert m2.wait(timeout=30) == JobStage.SUCCEEDED
