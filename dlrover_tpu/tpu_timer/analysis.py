@@ -1,0 +1,305 @@
+"""Profiler analysis tooling.
+
+Parity: reference xpu_timer/py_xpu_timer (~2.1k LoC: perfetto timeline
+generation, matmul analysis, stack viewer) — the TPU-native equivalents
+over this repo's artifacts:
+
+- ``timeline``: aggregate a (chrome-format) native timeline into
+  per-name statistics, kernel/collective shares, and device-busy
+  fraction inside xla capture windows.
+- ``stacks``: the stack viewer — parse faulthandler all-thread dumps
+  out of worker logs (SIGUSR2 post-mortems) and fold them into
+  collapsed-stack counts (flamegraph input) plus a top-frame histogram
+  that answers "where were the workers stuck".
+- ``matmul`` (python -m dlrover_tpu.tpu_timer.analysis matmul): sweep
+  MXU-shaped GEMMs on the local device and report achieved TFLOP/s and
+  efficiency vs peak — the host-qualification table the reference's
+  matmul analysis produces for GPUs.
+
+Usage::
+
+    python -m dlrover_tpu.tpu_timer.analysis timeline trace.json
+    python -m dlrover_tpu.tpu_timer.analysis stacks worker-*.log
+    python -m dlrover_tpu.tpu_timer.analysis matmul --sizes 2048,4096
+"""
+
+import argparse
+import collections
+import json
+import re
+import sys
+import time
+from typing import Dict, Iterable, List, Tuple
+
+# ---------------------------------------------------------------------------
+# Timeline analysis
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_timeline(trace: dict) -> dict:
+    """Aggregate a chrome-trace dict into per-name and per-category
+    statistics."""
+    events = trace.get("traceEvents", [])
+    by_name: Dict[str, List[Tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        by_name.setdefault(name, []).append(
+            (float(e.get("ts", 0.0)), float(e.get("dur", 0.0)))
+        )
+
+    names = {}
+    for name, spans in by_name.items():
+        durs = sorted(d for _, d in spans)
+        names[name] = {
+            "count": len(spans),
+            "total_us": round(sum(durs), 1),
+            "avg_us": round(sum(durs) / len(durs), 1),
+            "p50_us": round(_percentile(durs, 0.5), 1),
+            "p99_us": round(_percentile(durs, 0.99), 1),
+        }
+
+    def cat_total(pred) -> float:
+        return sum(
+            s["total_us"] for n, s in names.items() if pred(n)
+        )
+
+    kernels_us = cat_total(lambda n: n.startswith("xla/"))
+    coll_re = re.compile(
+        r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|ppermute"
+        r"|all[-_]?to[-_]?all|collective",
+        re.IGNORECASE,
+    )
+    collectives_us = cat_total(
+        lambda n: n.startswith("xla/") and coll_re.search(n)
+    )
+
+    # Device-busy fraction inside xla capture windows: the union of
+    # device-kernel intervals over the union of capture spans.
+    windows = [
+        (ts, ts + d) for ts, d in by_name.get("xla_capture", [])
+    ]
+    busy = 0.0
+    window_total = sum(e - s for s, e in windows)
+    if windows:
+        kernel_spans = sorted(
+            (ts, ts + d)
+            for n, spans in by_name.items()
+            if n.startswith("xla/")
+            for ts, d in spans
+        )
+        merged: List[List[float]] = []
+        for s, e in kernel_spans:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        busy = sum(e - s for s, e in merged)
+
+    return {
+        "names": dict(
+            sorted(
+                names.items(),
+                key=lambda kv: -kv[1]["total_us"],
+            )
+        ),
+        "device_kernel_us": round(kernels_us, 1),
+        "collective_us": round(collectives_us, 1),
+        "collective_share": round(
+            collectives_us / kernels_us, 4
+        ) if kernels_us else 0.0,
+        "capture_window_us": round(window_total, 1),
+        "device_busy_fraction": round(busy / window_total, 4)
+        if window_total
+        else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Stack viewer (faulthandler dumps in worker logs)
+# ---------------------------------------------------------------------------
+
+_THREAD_RE = re.compile(r"^(Current thread|Thread) (0x[0-9a-f]+)")
+_FRAME_RE = re.compile(r'^\s+File "(?P<file>[^"]+)", line (?P<line>\d+) in (?P<fn>.+)$')
+
+
+def parse_faulthandler_dumps(text: str) -> List[List[str]]:
+    """Extract per-thread stacks (outermost-first frame lists) from log
+    text containing faulthandler all-thread dumps."""
+    stacks: List[List[str]] = []
+    current: List[str] = []
+    in_stack = False
+    for line in text.splitlines():
+        if _THREAD_RE.match(line.strip()):
+            if current:
+                stacks.append(current)
+            current = []
+            in_stack = True
+            continue
+        m = _FRAME_RE.match(line)
+        if m and in_stack:
+            frame = f"{m.group('fn')} ({m.group('file').rsplit('/', 1)[-1]}:{m.group('line')})"  # noqa: E501
+            current.append(frame)
+        elif in_stack and line.strip() == "":
+            if current:
+                stacks.append(current)
+                current = []
+            in_stack = False
+    if current:
+        stacks.append(current)
+    # faulthandler prints innermost-first ("most recent call first");
+    # flamegraph convention is outermost-first.
+    return [list(reversed(s)) for s in stacks]
+
+
+def fold_stacks(stacks: Iterable[List[str]]) -> Dict[str, int]:
+    """Collapsed-stack counts: 'outer;...;inner' -> occurrences
+    (flamegraph.pl / speedscope input)."""
+    folded: Dict[str, int] = collections.Counter()
+    for stack in stacks:
+        if stack:
+            folded[";".join(stack)] += 1
+    return dict(folded)
+
+
+def top_frames(stacks: Iterable[List[str]], k: int = 10) -> List[Tuple[str, int]]:
+    """Histogram of innermost frames: where the threads actually were."""
+    counter: collections.Counter = collections.Counter()
+    for stack in stacks:
+        if stack:
+            counter[stack[-1]] += 1
+    return counter.most_common(k)
+
+
+# ---------------------------------------------------------------------------
+# Matmul analysis
+# ---------------------------------------------------------------------------
+
+_PEAK_BF16 = {
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,
+}
+
+
+def matmul_analysis(sizes: List[int], iters: int = 100) -> List[dict]:
+    """Achieved bf16 GEMM TFLOP/s per size vs device peak. Timing uses
+    a carry-chained in-jit scan (hoisting-proof) with a host fetch as
+    the barrier, so it is valid even over high-RTT device transports."""
+    import jax
+    import jax.numpy as jnp
+
+    kind = jax.devices()[0].device_kind
+    peak = next(
+        (
+            v
+            for k, v in sorted(
+                _PEAK_BF16.items(), key=lambda kv: -len(kv[0])
+            )
+            if kind.startswith(k)
+        ),
+        0.0,
+    )
+    rows = []
+    for n in sizes:
+        a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+
+        def scan_fn(a):
+            def body(carry, _):
+                out = carry @ carry
+                s = jnp.sum(out.astype(jnp.float32))
+                carry = carry + (s * 1e-30).astype(carry.dtype)
+                return carry, s
+
+            _, outs = jax.lax.scan(body, a, None, length=iters)
+            return outs[-1]
+
+        f = jax.jit(scan_fn)
+        float(f(a))  # compile
+        t0 = time.time()
+        float(f(a))
+        total = time.time() - t0
+        per_iter = total / iters
+        tflops = 2 * n**3 / per_iter / 1e12
+        rows.append(
+            {
+                "size": n,
+                "ms": round(per_iter * 1e3, 3),
+                "tflops": round(tflops, 3),
+                "efficiency_pct": round(100 * tflops * 1e12 / peak, 1)
+                if peak
+                else None,
+                "device": kind,
+            }
+        )
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="tpu_timer analysis")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_tl = sub.add_parser("timeline", help="aggregate a timeline JSON")
+    p_tl.add_argument("trace")
+    p_tl.add_argument("--top", type=int, default=15)
+
+    p_st = sub.add_parser("stacks", help="stack viewer over worker logs")
+    p_st.add_argument("logs", nargs="+")
+    p_st.add_argument("--folded", action="store_true",
+                      help="print collapsed stacks (flamegraph input)")
+
+    p_mm = sub.add_parser("matmul", help="MXU GEMM efficiency sweep")
+    p_mm.add_argument("--sizes", default="1024,2048,4096,8192")
+    p_mm.add_argument("--iters", type=int, default=100)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "timeline":
+        with open(args.trace) as f:
+            report = summarize_timeline(json.load(f))
+        top = dict(list(report["names"].items())[: args.top])
+        report["names"] = top
+        print(json.dumps(report, indent=2))
+        return 0
+
+    if args.cmd == "stacks":
+        stacks: List[List[str]] = []
+        for path in args.logs:
+            with open(path, errors="replace") as f:
+                stacks.extend(parse_faulthandler_dumps(f.read()))
+        if not stacks:
+            print("no faulthandler dumps found", file=sys.stderr)
+            return 1
+        if args.folded:
+            for stack, count in sorted(fold_stacks(stacks).items()):
+                print(f"{stack} {count}")
+        else:
+            print(f"{len(stacks)} thread stacks")
+            for frame, count in top_frames(stacks):
+                print(f"{count:6d}  {frame}")
+        return 0
+
+    if args.cmd == "matmul":
+        sizes = [int(s) for s in args.sizes.split(",") if s]
+        for row in matmul_analysis(sizes, args.iters):
+            print(json.dumps(row))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

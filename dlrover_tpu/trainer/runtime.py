@@ -123,6 +123,12 @@ def _maybe_start_tpu_timer(ctx: DistributedContext):
         if port:
             publish_port(ctx.local_rank, port)
         trace_gc()
+        # Kernel-level acquisition (PJRT trace listener) — the TPU
+        # analogue of the reference's LD_PRELOAD hook layer; gated by
+        # DLROVER_TPU_TIMER_XLA.
+        from dlrover_tpu.tpu_timer.xla_capture import maybe_start_listener
+
+        maybe_start_listener(ctx.local_rank)
     except Exception:
         logger.warning("tpu_timer daemon failed to start", exc_info=True)
 

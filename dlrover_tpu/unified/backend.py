@@ -187,8 +187,11 @@ class LocalProcessBackend(Backend):
     def poll(self, handle: WorkerHandle) -> Optional[int]:
         if handle.process is not None:
             return handle.process.poll()
-        # Re-attached: not our child; liveness via /proc, exit code via
-        # the rc-file the wrapper wrote.
+        # Re-attached: not our child. The rc-file is authoritative — it
+        # existing means the worker exited, whatever now occupies the
+        # pid (recycling) — then liveness via /proc.
+        if handle.rc_path and os.path.exists(handle.rc_path):
+            return self._read_rc(handle)
         if self._pid_alive(handle.pid):
             return None
         return self._read_rc(handle)
@@ -386,12 +389,20 @@ class RayBackend(Backend):
     def poll(self, handle):
         try:
             return self._ray.get(handle.actor.poll.remote(), timeout=30)
-        except Exception:
+        except self._ray.exceptions.RayActorError:
             logger.warning(
-                "ray actor %s unreachable; reporting failed",
-                handle.actor_name,
+                "ray actor %s is dead; reporting failed", handle.actor_name
             )
             return 1
+        except Exception:
+            # Transient control-plane trouble (GetTimeoutError, brief
+            # GCS unavailability) must NOT read as a worker failure — a
+            # false positive gang-restarts a healthy role.
+            logger.warning(
+                "ray actor %s poll inconclusive; retrying next tick",
+                handle.actor_name,
+            )
+            return None
 
     def stop_worker(self, handle, timeout: float = 10.0):
         try:

@@ -17,7 +17,7 @@ import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.unified.backend import Backend, LocalProcessBackend
+from dlrover_tpu.unified.backend import Backend, create_backend
 from dlrover_tpu.unified.config import DLJobConfig
 from dlrover_tpu.unified.graph import ExecutionGraph, build_execution_graph
 from dlrover_tpu.unified.scheduler import Placement, schedule
@@ -52,13 +52,17 @@ class PrimeManager:
     ):
         config.validate()
         self.config = config
-        self.backend = backend or LocalProcessBackend()
         self.state_backend = state_backend or build_state_backend(
             config.master_state_path
         )
         self.graph: ExecutionGraph = build_execution_graph(config)
         self.placement: Placement = schedule(
             self.graph, config, node_capacity
+        )
+        # Backend selection AFTER scheduling so the Ray backend gets the
+        # placement and can turn node slots into STRICT_PACK groups.
+        self.backend = backend or create_backend(
+            "auto", placement=self.placement
         )
         self.stage = JobStage.INIT
         self.submasters: Dict[str, SubMaster] = {

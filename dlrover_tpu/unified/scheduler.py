@@ -12,7 +12,6 @@ placement group; the local backend uses the assignment for env wiring
 and capacity accounting).
 """
 
-import math
 from dataclasses import dataclass, field
 from typing import Dict, List
 
@@ -65,22 +64,34 @@ def schedule(
     node_capacity = node_capacity or {}
     n_nodes = max(config.node_num, 1)
     bundle_ids = sorted(graph.bundles)
-    bundles_per_node = math.ceil(len(bundle_ids) / n_nodes)
+
+    def fits(slot: NodeSlot, need: Dict[str, float]) -> bool:
+        return all(
+            slot.resource.get(key, 0.0) + need.get(key, 0.0) <= limit
+            for key, limit in node_capacity.items()
+        )
 
     slots = [NodeSlot(index=i) for i in range(n_nodes)]
     bundle_to_slot: Dict[int, int] = {}
-    for i, bundle_id in enumerate(bundle_ids):
-        slot = slots[i // bundles_per_node]
+    # Balanced first-fit: emptiest slot first, then any slot with
+    # capacity — a big bundle on one node must not falsely reject a
+    # placement where the small ones fit elsewhere.
+    for bundle_id in bundle_ids:
         need = _bundle_resource(graph, config, bundle_id)
-        for key, limit in node_capacity.items():
-            used = slot.resource.get(key, 0.0)
-            want = need.get(key, 0.0)
-            if used + want > limit:
-                raise ValueError(
-                    f"bundle {bundle_id} needs {want} {key} but node "
-                    f"slot {slot.index} has {limit - used} of {limit} "
-                    f"left — reduce collocation or add nodes"
-                )
+        slot = next(
+            (
+                s
+                for s in sorted(slots, key=lambda s: len(s.bundles))
+                if fits(s, need)
+            ),
+            None,
+        )
+        if slot is None:
+            raise ValueError(
+                f"bundle {bundle_id} needs {need} but no node slot has "
+                f"capacity (per-node {node_capacity}, {n_nodes} nodes) "
+                f"— reduce collocation or add nodes"
+            )
         for key, val in need.items():
             slot.resource[key] = slot.resource.get(key, 0.0) + val
         slot.bundle_resources[bundle_id] = need

@@ -152,22 +152,31 @@ class ElasticSubMaster(SubMaster):
     re-runs its embedded rendezvous."""
 
     def reattach_or_launch(self, records: Dict[str, Dict]):
-        """Self-failover: adopt the role only if EVERY member is still
-        alive; one dead member means the world is gone, so the adopted
-        survivors are stopped and the whole role relaunches."""
+        """Self-failover: adopt the role only if every member is still
+        alive OR finished cleanly (exit 0 is completed work, not a lost
+        member); one FAILED/vanished member means the world is gone, so
+        the adopted survivors are stopped and the whole role
+        relaunches."""
         adopted: Dict[str, WorkerHandle] = {}
+        done: Dict[str, int] = {}
         whole = True
         for vertex in self.vertices:
             record = records.get(vertex.name)
             handle = (
                 self.backend.reattach(vertex, record) if record else None
             )
-            if handle is None or self.backend.poll(handle) is not None:
+            if handle is None:
                 whole = False
-            if handle is not None:
-                adopted[vertex.name] = handle
+                continue
+            code = self.backend.poll(handle)
+            if code == 0:
+                done[vertex.name] = 0
+            elif code is not None:
+                whole = False
+            adopted[vertex.name] = handle
         if whole and len(adopted) == len(self.vertices):
             self.handles = adopted
+            self._done.update(done)
             return
         logger.info(
             "elastic role %s lost members while the master was down; "

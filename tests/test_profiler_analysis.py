@@ -1,0 +1,87 @@
+"""Analysis tooling tests (tpu_timer/analysis.py): timeline
+aggregation, the stack viewer over faulthandler dumps, and the matmul
+sweep (tiny sizes on CPU). Mirrors reference py_xpu_timer coverage."""
+
+import json
+
+from dlrover_tpu.tpu_timer.analysis import (
+    fold_stacks,
+    main,
+    matmul_analysis,
+    parse_faulthandler_dumps,
+    summarize_timeline,
+    top_frames,
+)
+
+FAULTHANDLER_DUMP = """\
+some worker log line
+Current thread 0x00007f1 (most recent call first):
+  File "/opt/venv/lib/jax/_src/api.py", line 100 in block_until_ready
+  File "/root/repo/train.py", line 42 in train_step
+  File "/root/repo/train.py", line 99 in main
+
+Thread 0x00007f2 (most recent call first):
+  File "/usr/lib/python3.12/threading.py", line 355 in wait
+  File "/root/repo/loader.py", line 10 in fetch
+
+more log noise
+"""
+
+
+def test_parse_and_fold_stacks():
+    stacks = parse_faulthandler_dumps(FAULTHANDLER_DUMP)
+    assert len(stacks) == 2
+    # outermost-first after the reversal
+    assert stacks[0][0].startswith("main")
+    assert stacks[0][-1].startswith("block_until_ready")
+    folded = fold_stacks(stacks + stacks)
+    assert all(c == 2 for c in folded.values())
+    top = top_frames(stacks)
+    assert top[0][0].startswith(("block_until_ready", "wait"))
+
+
+def test_summarize_timeline_categories():
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "name": "xla_capture", "ts": 0.0, "dur": 100.0},
+            {"ph": "X", "name": "xla/jit_matmul", "ts": 10.0, "dur": 40.0},
+            {"ph": "X", "name": "xla/all-reduce.3", "ts": 55.0, "dur": 20.0},
+            {"ph": "X", "name": "xla/jit_matmul", "ts": 80.0, "dur": 10.0},
+            {"ph": "X", "name": "train_step", "ts": 0.0, "dur": 100.0},
+        ]
+    }
+    report = summarize_timeline(trace)
+    assert report["names"]["xla/jit_matmul"]["count"] == 2
+    assert report["device_kernel_us"] == 70.0
+    assert report["collective_us"] == 20.0
+    assert abs(report["collective_share"] - 20 / 70) < 1e-3
+    # busy 70us of a 100us window
+    assert abs(report["device_busy_fraction"] - 0.7) < 1e-3
+
+
+def test_timeline_cli(tmp_path, capsys):
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "name": "xla/fusion", "ts": 0.0, "dur": 5.0}
+        ]
+    }
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(trace))
+    assert main(["timeline", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "xla/fusion" in out["names"]
+
+
+def test_stacks_cli(tmp_path, capsys):
+    log = tmp_path / "w.log"
+    log.write_text(FAULTHANDLER_DUMP)
+    assert main(["stacks", str(log)]) == 0
+    assert "thread stacks" in capsys.readouterr().out
+    assert main(["stacks", "--folded", str(log)]) == 0
+    assert ";" in capsys.readouterr().out
+
+
+def test_matmul_analysis_runs_small():
+    rows = matmul_analysis([64], iters=3)
+    assert rows[0]["size"] == 64
+    assert rows[0]["tflops"] > 0

@@ -401,3 +401,20 @@ def test_elastic_role_gang_relaunches_on_partial_adoption(
     assert pids2["trainer-1"] != pids1["trainer-1"]
     flag.write_text("go")
     assert m2.wait(timeout=30) == JobStage.SUCCEEDED
+
+
+def test_scheduler_first_fit_finds_feasible_mix():
+    """A big bundle plus small ones must not be falsely rejected by
+    contiguous block assignment: first-fit places [4, 1, 1, 1] chips
+    onto two 4-chip nodes."""
+    from dlrover_tpu.unified.scheduler import schedule
+
+    b = DLJobBuilder().nnodes(2)
+    b = b.role("big").run("m.big").resource(tpu_chips=4).add()
+    for i in range(3):
+        b = b.role(f"small{i}").run("m.s").resource(tpu_chips=1).add()
+    job = b.build()
+    graph = build_execution_graph(job)
+    placement = schedule(graph, job, node_capacity={"tpu_chips": 4})
+    used = {s.index: s.resource.get("tpu_chips", 0) for s in placement.slots}
+    assert sorted(used.values()) == [3, 4]
