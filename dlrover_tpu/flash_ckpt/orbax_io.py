@@ -1,0 +1,184 @@
+"""Orbax interop: export/import flash checkpoints to the JAX
+ecosystem's standard layout.
+
+Parity intent: the reference's savers deliberately write framework-
+native formats so checkpoints interop with the surrounding ecosystem
+(elastic_agent/torch/ckpt_saver.py:1341-1450 writes real torch/
+DeepSpeed/Megatron layouts). The flash engine's own format (npz +
+restricted-pickle meta, flash_ckpt/storage.py) is optimized for the
+shm fast path and self-restore; this module bridges it to orbax
+(tensorstore) so anything in the JAX world — orbax restore in another
+trainer, model surgery tools, eval harnesses — can consume or produce
+dlrover-tpu checkpoints.
+
+    from dlrover_tpu.flash_ckpt import orbax_io
+    orbax_io.export_step(flash_dir, orbax_dir)           # latest step
+    step, state = orbax_io.load_orbax(orbax_dir)         # any tool
+    orbax_io.import_step(orbax_dir, flash_dir)           # back in
+
+CLI: ``python -m dlrover_tpu.flash_ckpt.orbax_io export|import ...``.
+"""
+
+import argparse
+import json
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.flash_ckpt import storage as fstorage
+
+_META_FILE = "dlrover_tpu_meta.json"
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+# ---------------------------------------------------------------------------
+# Flash -> orbax
+# ---------------------------------------------------------------------------
+
+
+def export_step(
+    flash_dir: str,
+    orbax_dir: str,
+    step: Optional[int] = None,
+) -> int:
+    """Write one flash step as an orbax checkpoint
+    (``{orbax_dir}/{step}``). Returns the exported step."""
+    from dlrover_tpu.flash_ckpt.engine import load_global_state
+
+    ocp = _ocp()
+    if step is None:
+        committed = fstorage.read_tracker(flash_dir)
+        steps = fstorage.list_step_dirs(flash_dir)
+        candidates = [s for s in steps if s <= committed] or steps
+        if not candidates:
+            raise FileNotFoundError(
+                f"no flash checkpoint steps under {flash_dir}"
+            )
+        step = max(candidates)
+    metas = fstorage.load_step_meta(flash_dir, step)
+    if not metas:
+        raise FileNotFoundError(
+            f"flash step {step} has no metadata under {flash_dir}"
+        )
+    loaded = load_global_state(flash_dir, step, metas)
+    if loaded is None:
+        raise RuntimeError(
+            f"flash step {step} is incomplete (missing shards)"
+        )
+    _, state, user_meta = loaded
+    path = os.path.join(os.path.abspath(orbax_dir), str(step))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump({"step": step, "user_meta": user_meta}, f, default=str)
+    logger.info("exported flash step %d -> orbax at %s", step, path)
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Orbax -> flash (or direct use)
+# ---------------------------------------------------------------------------
+
+
+def list_orbax_steps(orbax_dir: str):
+    steps = []
+    try:
+        for name in os.listdir(orbax_dir):
+            if name.isdigit():
+                steps.append(int(name))
+    except OSError:
+        pass
+    return sorted(steps)
+
+
+def load_orbax(
+    orbax_dir: str, step: Optional[int] = None
+) -> Tuple[int, Any]:
+    """Load an orbax checkpoint (written by this module or any orbax
+    producer) as a numpy pytree."""
+    ocp = _ocp()
+    if step is None:
+        steps = list_orbax_steps(orbax_dir)
+        if not steps:
+            raise FileNotFoundError(f"no orbax steps under {orbax_dir}")
+        step = steps[-1]
+    path = os.path.join(os.path.abspath(orbax_dir), str(step))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        state = ckptr.restore(path)
+    return step, state
+
+
+def import_step(
+    orbax_dir: str,
+    flash_dir: str,
+    step: Optional[int] = None,
+) -> int:
+    """Bring an orbax checkpoint into the flash layout so the elastic
+    restore path (memory-first fallback to storage, replicas, re-mesh
+    device placement) can serve it."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.flash_ckpt.shm_handler import LeafMeta, ShardMeta
+
+    step, state = load_orbax(orbax_dir, step)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {}
+    leaf_metas = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        bounds = tuple((0, s) for s in arr.shape)
+        leaf_metas.append(
+            LeafMeta(
+                leaf_id=i,
+                global_shape=tuple(arr.shape),
+                dtype=str(arr.dtype),
+                shards=[ShardMeta(bounds, tuple(arr.shape))],
+                replicated=True,
+            )
+        )
+        arrays[f"leaf{i}_shard0"] = arr
+    meta = {
+        "step": step,
+        "treedef": pickle.dumps(treedef),
+        "leaves": leaf_metas,
+        "user_meta": {"imported_from": os.path.abspath(orbax_dir)},
+        "num_processes": 1,
+    }
+    fstorage.persist_node_shards(
+        flash_dir, step, node_rank=0,
+        proc_payloads={0: {"arrays": arrays, "meta": meta}},
+    )
+    fstorage.write_tracker(flash_dir, step)
+    logger.info("imported orbax step %d -> flash at %s", step, flash_dir)
+    return step
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="flash <-> orbax bridge")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_exp = sub.add_parser("export", help="flash checkpoint -> orbax")
+    p_exp.add_argument("--flash-dir", required=True)
+    p_exp.add_argument("--orbax-dir", required=True)
+    p_exp.add_argument("--step", type=int, default=None)
+    p_imp = sub.add_parser("import", help="orbax checkpoint -> flash")
+    p_imp.add_argument("--orbax-dir", required=True)
+    p_imp.add_argument("--flash-dir", required=True)
+    p_imp.add_argument("--step", type=int, default=None)
+    args = parser.parse_args(argv)
+    if args.cmd == "export":
+        step = export_step(args.flash_dir, args.orbax_dir, args.step)
+    else:
+        step = import_step(args.orbax_dir, args.flash_dir, args.step)
+    print(step)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,13 +1,16 @@
-"""Master web dashboard: job/node state over HTTP.
+"""Master web dashboard: job/node/rendezvous/data state over HTTP.
 
 Parity: reference dlrover/dashboard (tornado app wired at
-master/main.py:100-107) — rebuilt on the stdlib HTTP server: JSON APIs
-(/api/job, /api/perf) plus a single self-contained HTML page rendering
-the node table and training progress.
+master/main.py:100-107, jobs/nodes UI) — rebuilt on the stdlib HTTP
+server: JSON APIs (/api/job, /api/perf, /api/nodes, /api/rdzv,
+/api/datasets) plus a single self-contained HTML page rendering the
+node table (status, exit history, heartbeat age, slice block), the
+rendezvous state, dataset progress, and training perf.
 """
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -17,44 +20,76 @@ _PAGE = """<!DOCTYPE html>
 <html><head><title>dlrover-tpu</title>
 <style>
 body{font-family:monospace;margin:2em;background:#fafafa}
-table{border-collapse:collapse}td,th{border:1px solid #999;padding:4px 10px}
-h1{font-size:1.3em}.Running{color:green}.Failed,.Breakdown{color:red}
+table{border-collapse:collapse;margin-bottom:1.2em}
+td,th{border:1px solid #999;padding:4px 10px}
+h1{font-size:1.3em}h2{font-size:1.05em;margin-bottom:.3em}
+.Running{color:green}.Failed,.Breakdown{color:red}
 .Pending,.Initial{color:#b8860b}.Succeeded{color:blue}
 </style></head><body>
 <h1>dlrover-tpu job <span id="job"></span></h1>
 <p>stage: <b id="stage"></b> | step: <b id="step"></b> |
 speed: <b id="speed"></b> steps/s | goodput: <b id="goodput"></b>%</p>
-<table id="nodes"><tr><th>id</th><th>rank</th><th>status</th>
-<th>relaunches</th><th>host</th></tr></table>
+<h2>nodes</h2>
+<table id="nodes"><tr><th>id</th><th>rank</th><th>block</th>
+<th>status</th><th>relaunches</th><th>exit history</th>
+<th>heartbeat</th><th>host</th></tr></table>
+<h2>rendezvous</h2>
+<table id="rdzv"><tr><th>name</th><th>round</th><th>waiting</th>
+<th>world</th></tr></table>
+<h2>datasets</h2>
+<table id="data"><tr><th>name</th><th>todo</th><th>doing</th>
+<th>completed</th><th>records done</th></tr></table>
 <script>
+async function j(u){return await (await fetch(u)).json();}
+function fill(t, rows){
+ while(t.rows.length > 1) t.deleteRow(1);
+ for(const cells of rows){
+  const r = t.insertRow();
+  for(const [v, cls] of cells){
+   const c = r.insertCell(); c.textContent = v;
+   if(cls) c.className = cls;
+  }
+ }
+}
 async function refresh(){
- const job = await (await fetch('/api/job')).json();
- const perf = await (await fetch('/api/perf')).json();
+ const job = await j('/api/job');
+ const perf = await j('/api/perf');
+ const nodes = await j('/api/nodes');
+ const rdzv = await j('/api/rdzv');
+ const data = await j('/api/datasets');
  document.getElementById('job').textContent = job.job_name;
  document.getElementById('stage').textContent = job.stage;
  document.getElementById('step').textContent = perf.global_step;
  document.getElementById('speed').textContent = perf.speed.toFixed(2);
  document.getElementById('goodput').textContent = (perf.goodput*100).toFixed(1);
- const t = document.getElementById('nodes');
- while(t.rows.length > 1) t.deleteRow(1);
- for(const [id, n] of Object.entries(job.nodes)){
-  const r = t.insertRow();
-  r.insertCell().textContent = id;
-  r.insertCell().textContent = n.rank;
-  const c = r.insertCell(); c.textContent = n.status;
-  c.className = n.status;
-  r.insertCell().textContent = n.relaunch_count;
-  r.insertCell().textContent = n.host || '';
- }
+ fill(document.getElementById('nodes'), nodes.map(n => [
+  [n.id], [n.rank], [n.node_group < 0 ? '-' : n.node_group],
+  [n.status, n.status], [n.relaunch_count],
+  [n.exit_history.join(',') || '-'],
+  [n.heartbeat_age_s == null ? '-' : n.heartbeat_age_s + 's'],
+  [n.host || '']]));
+ fill(document.getElementById('rdzv'), rdzv.map(r => [
+  [r.name], [r.round], [r.waiting], [r.world_size]]));
+ fill(document.getElementById('data'), data.map(d => [
+  [d.name], [d.todo], [d.doing], [d.completed], [d.records_done]]));
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
 
 
 class DashboardServer:
-    def __init__(self, job_manager, perf_monitor, port: int = 0):
+    def __init__(
+        self,
+        job_manager,
+        perf_monitor,
+        port: int = 0,
+        rdzv_managers=None,
+        task_manager=None,
+    ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
+        self._rdzv_managers = rdzv_managers or {}
+        self._task_manager = task_manager
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self.port = 0
@@ -77,6 +112,24 @@ class DashboardServer:
                     self._send(
                         200,
                         json.dumps(dashboard._perf()),
+                        "application/json",
+                    )
+                elif self.path == "/api/nodes":
+                    self._send(
+                        200,
+                        json.dumps(dashboard._nodes()),
+                        "application/json",
+                    )
+                elif self.path == "/api/rdzv":
+                    self._send(
+                        200,
+                        json.dumps(dashboard._rdzv()),
+                        "application/json",
+                    )
+                elif self.path == "/api/datasets":
+                    self._send(
+                        200,
+                        json.dumps(dashboard._datasets()),
                         "application/json",
                     )
                 else:
@@ -106,6 +159,69 @@ class DashboardServer:
             "speed": self._perf_monitor.running_speed(),
             "goodput": self._perf_monitor.goodput(),
         }
+
+    def _nodes(self):
+        manager = getattr(self._job_manager, "worker_manager", None)
+        if manager is None:
+            return []
+        now = time.time()
+        rows = []
+        for node in sorted(
+            manager.nodes.values(), key=lambda n: (n.rank_index, n.id)
+        ):
+            rows.append(
+                {
+                    "id": node.id,
+                    "rank": node.rank_index,
+                    "node_group": node.node_group,
+                    "status": node.status,
+                    "relaunch_count": node.relaunch_count,
+                    "exit_reason": node.exit_reason,
+                    "exit_history": list(node.exit_history),
+                    "heartbeat_age_s": (
+                        round(now - node.heartbeat_time)
+                        if node.heartbeat_time > 0
+                        else None
+                    ),
+                    "host": node.host_name,
+                }
+            )
+        return rows
+
+    def _rdzv(self):
+        rows = []
+        for name, mgr in self._rdzv_managers.items():
+            rows.append(
+                {
+                    "name": name,
+                    "round": getattr(mgr, "_rdzv_round", 0),
+                    "waiting": mgr.num_nodes_waiting(),
+                    "world_size": len(getattr(mgr, "_latest_world", {})),
+                }
+            )
+        return rows
+
+    def _datasets(self):
+        if self._task_manager is None:
+            return []
+        rows = []
+        with self._task_manager._lock:  # noqa: SLF001 - read-only view
+            datasets = dict(self._task_manager._datasets)  # noqa: SLF001
+        for name, mgr in datasets.items():
+            rows.append(
+                {
+                    "name": name,
+                    "todo": len(mgr.todo),
+                    "doing": len(mgr.doing),
+                    "completed": getattr(mgr, "_completed_count", 0),
+                    "records_done": (
+                        mgr.completed_records()
+                        if hasattr(mgr, "completed_records")
+                        else 0
+                    ),
+                }
+            )
+        return rows
 
     def start(self):
         # Bind lazily and degrade gracefully: a taken port must not take

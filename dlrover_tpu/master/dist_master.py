@@ -143,7 +143,11 @@ class DistributedJobMaster:
             from dlrover_tpu.master.dashboard import DashboardServer
 
             self.dashboard = DashboardServer(
-                self.job_manager, self.perf_monitor, dashboard_port
+                self.job_manager,
+                self.perf_monitor,
+                dashboard_port,
+                rdzv_managers=self.rdzv_managers,
+                task_manager=self.task_manager,
             )
         self.auto_scaler = None
         if auto_scale:

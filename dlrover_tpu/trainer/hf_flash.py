@@ -1,0 +1,163 @@
+"""HuggingFace Trainer front-end for flash checkpointing.
+
+Parity: reference trainer/torch/flash_checkpoint/hf_trainer.py
+(FlashCkptTrainer) — HF ``Trainer`` users get second-scale in-memory
+checkpoints + elastic resume without changing their training loop:
+
+    from dlrover_tpu.trainer.hf_flash import FlashCkptCallback
+
+    trainer = Trainer(..., callbacks=[FlashCkptCallback("/tmp/ckpt")])
+    trainer.train()
+
+On every HF save event the callback snapshots model + optimizer +
+scheduler state to the flash engine (shm fast path; agent persists to
+disk per its policy), and at train start it restores the newest
+snapshot — so a relaunched worker resumes from the last flash save,
+not the last (much older) disk save. Torch tensors cross into the
+engine as numpy (zero-copy where possible); the engine is framework-
+agnostic pytrees, which is exactly why this front-end is thin.
+"""
+
+from typing import Any, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.flash_ckpt.checkpointer import Checkpointer, StorageType
+
+
+def _tensor_to_numpy(t):
+    import ml_dtypes
+    import numpy as np
+    import torch
+
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        # numpy has no native bf16: exact bit-level bridge via uint16.
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    try:
+        return t.numpy()
+    except TypeError:
+        # Other numpy-unsupported dtypes (fp8 etc.): upcast.
+        return t.float().numpy().astype(np.float32)
+
+
+def _to_numpy_tree(obj: Any):
+    import numpy as np
+    import torch
+
+    if isinstance(obj, torch.Tensor):
+        return _tensor_to_numpy(obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        converted = [_to_numpy_tree(v) for v in obj]
+        return type(obj)(converted) if isinstance(obj, tuple) else converted
+    if isinstance(obj, (int, float, bool, str)) or obj is None:
+        return obj
+    return np.asarray(obj)
+
+
+def _to_torch_tree(obj: Any):
+    import ml_dtypes
+    import numpy as np
+    import torch
+
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == ml_dtypes.bfloat16:
+            return torch.from_numpy(
+                obj.view(np.uint16).copy()
+            ).view(torch.bfloat16)
+        return torch.from_numpy(obj.copy())
+    if isinstance(obj, dict):
+        return {k: _to_torch_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        converted = [_to_torch_tree(v) for v in obj]
+        return type(obj)(converted) if isinstance(obj, tuple) else converted
+    return obj
+
+
+def snapshot_training_state(model, optimizer=None, scheduler=None) -> dict:
+    state = {"model": _to_numpy_tree(model.state_dict())}
+    if optimizer is not None:
+        state["optimizer"] = _to_numpy_tree(optimizer.state_dict())
+    if scheduler is not None:
+        state["scheduler"] = _to_numpy_tree(scheduler.state_dict())
+    return state
+
+
+def restore_training_state(
+    state: dict, model, optimizer=None, scheduler=None
+):
+    model.load_state_dict(_to_torch_tree(state["model"]))
+    if optimizer is not None and "optimizer" in state:
+        optimizer.load_state_dict(_to_torch_tree(state["optimizer"]))
+    if scheduler is not None and "scheduler" in state:
+        scheduler.load_state_dict(_to_torch_tree(state["scheduler"]))
+
+
+try:
+    from transformers import TrainerCallback as _CallbackBase
+except ImportError:  # transformers is optional for the rest of the repo
+
+    class _CallbackBase:  # type: ignore[no-redef]
+        pass
+
+
+class FlashCkptCallback(_CallbackBase):
+    """HF TrainerCallback: flash-save on HF's save cadence, restore at
+    train begin. ``storage_interval`` additionally persists every Nth
+    flash save to disk through the engine (0 = memory-only; the agent's
+    async saver still persists on failure)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage_interval: int = 0,
+        checkpointer: Optional[Checkpointer] = None,
+    ):
+        self._ckpt = checkpointer or Checkpointer(checkpoint_dir)
+        self._storage_interval = storage_interval
+        self._saves = 0
+
+    # ---- HF hooks ----------------------------------------------------------
+
+    def on_train_begin(self, args, state, control, **kw):
+        model = kw.get("model")
+        optimizer = kw.get("optimizer")
+        scheduler = kw.get("lr_scheduler")
+        restored = self._ckpt.load_checkpoint(to_device=False)
+        if restored is None or model is None:
+            return
+        step, np_state, _ = restored
+        restore_training_state(np_state, model, optimizer, scheduler)
+        state.global_step = step
+        logger.info("flash-restored HF trainer at step %d", step)
+
+    def on_save(self, args, state, control, **kw):
+        model = kw.get("model")
+        if model is None:
+            return
+        self._saves += 1
+        snap = snapshot_training_state(
+            model, kw.get("optimizer"), kw.get("lr_scheduler")
+        )
+        storage = (
+            StorageType.DISK
+            if self._storage_interval
+            and self._saves % self._storage_interval == 0
+            else StorageType.MEMORY
+        )
+        block = self._ckpt.save_checkpoint(
+            state.global_step, snap, storage
+        )
+        logger.info(
+            "flash save at step %d (%s, blocked %.3fs)",
+            state.global_step,
+            storage,
+            block,
+        )
+
+    def on_train_end(self, args, state, control, **kw):
+        self._ckpt.wait_saving_complete()
+
+    def close(self):
+        self._ckpt.close()

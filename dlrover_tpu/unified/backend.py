@@ -335,6 +335,7 @@ class RayBackend(Backend):
         self._actor_cls = ray.remote(UnifiedWorkerActor)
         self._placement = placement
         self._groups: Dict[int, object] = {}
+        self._inconclusive: Dict[str, int] = {}
 
     def _group_for(self, vertex: Vertex):
         """One placement group per node slot with one bundle per
@@ -386,9 +387,15 @@ class RayBackend(Backend):
         logger.info("started ray worker actor %s", name)
         return WorkerHandle(vertex=vertex, actor=actor, actor_name=name)
 
+    # Consecutive inconclusive polls tolerated before a wedged-but-
+    # alive actor is declared failed anyway.
+    MAX_INCONCLUSIVE_POLLS = 10
+
     def poll(self, handle):
         try:
-            return self._ray.get(handle.actor.poll.remote(), timeout=30)
+            code = self._ray.get(handle.actor.poll.remote(), timeout=30)
+            self._inconclusive.pop(handle.actor_name, None)
+            return code
         except self._ray.exceptions.RayActorError:
             logger.warning(
                 "ray actor %s is dead; reporting failed", handle.actor_name
@@ -397,10 +404,25 @@ class RayBackend(Backend):
         except Exception:
             # Transient control-plane trouble (GetTimeoutError, brief
             # GCS unavailability) must NOT read as a worker failure — a
-            # false positive gang-restarts a healthy role.
+            # false positive gang-restarts a healthy role. But a
+            # PERMANENTLY unreachable/wedged actor must not hang the
+            # job either: a consecutive-miss budget breaks the tie.
+            misses = self._inconclusive.get(handle.actor_name, 0) + 1
+            self._inconclusive[handle.actor_name] = misses
+            if misses >= self.MAX_INCONCLUSIVE_POLLS:
+                logger.error(
+                    "ray actor %s unreachable for %d consecutive polls; "
+                    "reporting failed",
+                    handle.actor_name,
+                    misses,
+                )
+                self._inconclusive.pop(handle.actor_name, None)
+                return 1
             logger.warning(
-                "ray actor %s poll inconclusive; retrying next tick",
+                "ray actor %s poll inconclusive (%d/%d); retrying",
                 handle.actor_name,
+                misses,
+                self.MAX_INCONCLUSIVE_POLLS,
             )
             return None
 

@@ -73,11 +73,24 @@ def schedule(
 
     slots = [NodeSlot(index=i) for i in range(n_nodes)]
     bundle_to_slot: Dict[int, int] = {}
-    # Balanced first-fit: emptiest slot first, then any slot with
-    # capacity — a big bundle on one node must not falsely reject a
-    # placement where the small ones fit elsewhere.
-    for bundle_id in bundle_ids:
-        need = _bundle_resource(graph, config, bundle_id)
+    # First-fit-DECREASING with balance preference: big bundles place
+    # first (small ones spread across nodes first would strand the big
+    # one), each into the emptiest slot that fits.
+    needs = {
+        bundle_id: _bundle_resource(graph, config, bundle_id)
+        for bundle_id in bundle_ids
+    }
+
+    def constrained_need(bundle_id: int) -> float:
+        need = needs[bundle_id]
+        if not node_capacity:
+            return sum(need.values())
+        return sum(need.get(key, 0.0) for key in node_capacity)
+
+    for bundle_id in sorted(
+        bundle_ids, key=lambda b: (-constrained_need(b), b)
+    ):
+        need = needs[bundle_id]
         slot = next(
             (
                 s

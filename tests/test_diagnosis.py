@@ -310,3 +310,38 @@ def test_budget_beats_signature_escalation():
         exit_codes={0: 1}, restart_count=3, max_restarts=3, log_tail=[]
     )
     assert agent.diagnose_training_failure(ctx) == WorkerAction.FAIL_JOB
+
+
+def test_chaos_finds_and_kills_local_worker(tmp_path):
+    """Chaos harness targets only processes carrying the agent-injected
+    worker env of the named job."""
+    import os
+    import subprocess
+    import sys
+    import time as _time
+
+    from dlrover_tpu.testing import chaos
+
+    env = dict(os.environ)
+    env["DLROVER_TPU_JOB_NAME"] = "chaosjob"
+    env["DLROVER_TPU_PROCESS_ID"] = "0"
+    victim = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"], env=env
+    )
+    try:
+        deadline = _time.time() + 10
+        found = []
+        while _time.time() < deadline:
+            found = chaos.find_local_workers("chaosjob")
+            if (victim.pid, 0) in found:
+                break
+            _time.sleep(0.1)
+        assert (victim.pid, 0) in found
+        # The harness itself (no PROCESS_ID env) is never a target.
+        assert os.getpid() not in [p for p, _ in found]
+        killed = chaos.kill_one_local("chaosjob")
+        assert killed == victim.pid
+        assert victim.wait(10) != 0
+    finally:
+        if victim.poll() is None:
+            victim.kill()

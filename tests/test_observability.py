@@ -134,3 +134,73 @@ def test_dashboard_serves_page_and_apis():
         conn.close()
     finally:
         dash.stop()
+
+
+def test_dashboard_new_apis():
+    """/api/nodes, /api/rdzv, /api/datasets over real components."""
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        create_rdzv_managers,
+    )
+    from dlrover_tpu.master.node.dist_job_manager import (
+        DistributedJobManager,
+    )
+    from dlrover_tpu.master.shard.task_manager import TaskManager
+    from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+    from dlrover_tpu.testing.sim_cluster import (
+        SimCluster,
+        SimNodeWatcher,
+        SimScaler,
+    )
+
+    cluster = SimCluster()
+    mgr = DistributedJobManager(
+        job_name="dash2",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=2, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        scaler=SimScaler("dash2", cluster),
+        watcher=SimNodeWatcher("dash2", cluster),
+        node_group_size=2,
+    )
+    for node in mgr.worker_manager.init_nodes():
+        node.update_status(NodeStatus.RUNNING)
+    rdzv = create_rdzv_managers()
+    list(rdzv.values())[0].join_rendezvous(0, 0, 1)
+    tm = TaskManager()
+    tm.new_dataset(
+        comm.DatasetShardParams(
+            dataset_name="d1", dataset_size=10, shard_size=5,
+            storage_type="table",
+        )
+    )
+    tm.get_task(0, "d1")
+
+    perf = PerfMonitor()
+    dash = DashboardServer(
+        mgr, perf, port=0, rdzv_managers=rdzv, task_manager=tm
+    )
+    dash.start()
+    try:
+        def get(path):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", dash.port, timeout=5
+            )
+            conn.request("GET", path)
+            data = json.loads(conn.getresponse().read())
+            conn.close()
+            return data
+
+        nodes = get("/api/nodes")
+        assert len(nodes) == 2
+        assert nodes[0]["node_group"] == 0
+        assert nodes[0]["exit_history"] == []
+        rdzv_rows = get("/api/rdzv")
+        assert any(r["waiting"] == 1 for r in rdzv_rows)
+        data_rows = get("/api/datasets")
+        assert data_rows[0]["name"] == "d1"
+        assert data_rows[0]["doing"] == 1
+    finally:
+        dash.stop()

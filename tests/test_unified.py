@@ -418,3 +418,22 @@ def test_scheduler_first_fit_finds_feasible_mix():
     placement = schedule(graph, job, node_capacity={"tpu_chips": 4})
     used = {s.index: s.resource.get("tpu_chips", 0) for s in placement.slots}
     assert sorted(used.values()) == [3, 4]
+
+
+def test_scheduler_ffd_big_bundle_last():
+    """The confirmed-repro case: [2, 2, 4] chips on two 4-chip nodes is
+    feasible only if the big bundle places FIRST (first-fit-decreasing),
+    regardless of declaration order."""
+    from dlrover_tpu.unified.scheduler import schedule
+
+    b = DLJobBuilder().nnodes(2)
+    b = b.role("s0").run("m").resource(tpu_chips=2).add()
+    b = b.role("s1").run("m").resource(tpu_chips=2).add()
+    b = b.role("big").run("m").resource(tpu_chips=4).add()
+    job = b.build()
+    graph = build_execution_graph(job)
+    placement = schedule(graph, job, node_capacity={"tpu_chips": 4})
+    used = sorted(
+        s.resource.get("tpu_chips", 0) for s in placement.slots
+    )
+    assert used == [4, 4]
