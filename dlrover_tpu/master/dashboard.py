@@ -30,7 +30,7 @@ h1{font-size:1.3em}h2{font-size:1.05em;margin-bottom:.3em}
 <p>stage: <b id="stage"></b> | step: <b id="step"></b> |
 speed: <b id="speed"></b> steps/s | goodput: <b id="goodput"></b>%</p>
 <h2>nodes</h2>
-<table id="nodes"><tr><th>id</th><th>rank</th><th>block</th>
+<table id="nodes"><tr><th>id</th><th>role</th><th>rank</th><th>block</th>
 <th>status</th><th>relaunches</th><th>exit history</th>
 <th>heartbeat</th><th>host</th></tr></table>
 <h2>rendezvous</h2>
@@ -63,7 +63,7 @@ async function refresh(){
  document.getElementById('speed').textContent = perf.speed.toFixed(2);
  document.getElementById('goodput').textContent = (perf.goodput*100).toFixed(1);
  fill(document.getElementById('nodes'), nodes.map(n => [
-  [n.id], [n.rank], [n.node_group < 0 ? '-' : n.node_group],
+  [n.id], [n.type], [n.rank], [n.node_group < 0 ? '-' : n.node_group],
   [n.status, n.status], [n.relaunch_count],
   [n.exit_history.join(',') || '-'],
   [n.heartbeat_age_s == null ? '-' : n.heartbeat_age_s + 's'],
@@ -161,17 +161,24 @@ class DashboardServer:
         }
 
     def _nodes(self):
-        manager = getattr(self._job_manager, "worker_manager", None)
-        if manager is None:
-            return []
+        managers = getattr(self._job_manager, "role_managers", None)
+        if managers is None:
+            worker = getattr(self._job_manager, "worker_manager", None)
+            if worker is None:
+                return []
+            managers = {"worker": worker}
+        all_nodes = []
+        for manager in managers.values():
+            all_nodes.extend(manager.nodes.values())
         now = time.time()
         rows = []
         for node in sorted(
-            manager.nodes.values(), key=lambda n: (n.rank_index, n.id)
+            all_nodes, key=lambda n: (n.type, n.rank_index, n.id)
         ):
             rows.append(
                 {
                     "id": node.id,
+                    "type": node.type,
                     "rank": node.rank_index,
                     "node_group": node.node_group,
                     "status": node.status,

@@ -33,7 +33,10 @@ from dlrover_tpu.diagnosis.actions import DiagnosisAction, NodeAction
 from dlrover_tpu.master.node.event_callback import NodeEventCallback
 from dlrover_tpu.master.node.exit_reason import classify_exit
 from dlrover_tpu.master.node.job_context import get_job_context
-from dlrover_tpu.master.node.training_node import WorkerManager
+from dlrover_tpu.master.node.training_node import (
+    WorkerManager,
+    create_role_manager,
+)
 from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
 from dlrover_tpu.master.watcher.base_watcher import NodeWatcher
 from dlrover_tpu.training_event import MasterEvents
@@ -80,16 +83,21 @@ class DistributedJobManager:
         # handle_node_joined records the mapping here.
         self._id_alias: Dict[int, int] = {}
 
-        worker_group = node_groups.get(
-            NodeType.WORKER, NodeGroupResource(count=1)
-        )
-        self._worker_manager = WorkerManager(
-            worker_group,
-            self._new_node_id,
-            max_relaunch_count,
-            node_group_size=node_group_size,
-        )
-        self._managers = {NodeType.WORKER: self._worker_manager}
+        # Per-role managers (reference runs worker/chief/evaluator/ps
+        # manager instances side by side; TF PS is by-design absent).
+        groups = dict(node_groups)
+        groups.setdefault(NodeType.WORKER, NodeGroupResource(count=1))
+        self._managers = {
+            node_type: create_role_manager(
+                node_type,
+                group,
+                self._new_node_id,
+                max_relaunch_count,
+                node_group_size=node_group_size,
+            )
+            for node_type, group in groups.items()
+        }
+        self._worker_manager = self._managers[NodeType.WORKER]
 
     # ---- wiring ------------------------------------------------------------
 
@@ -99,6 +107,10 @@ class DistributedJobManager:
     @property
     def worker_manager(self) -> WorkerManager:
         return self._worker_manager
+
+    @property
+    def role_managers(self):
+        return dict(self._managers)
 
     def set_master_addr(self, addr: str):
         self._scaler.set_master_addr(addr)
@@ -117,23 +129,28 @@ class DistributedJobManager:
         # Reconcile: adopt nodes that already exist in the backend (master
         # restart while workers keep running, reference
         # dist_job_manager.py _init_nodes), launch only the missing ranks.
-        existing = {
-            n.rank_index: n
+        backend_nodes = [
+            n
             for n in self._watcher.list()
-            if n.type == NodeType.WORKER
-            and n.status not in NodeStatus.end_states()
-        }
+            if n.status not in NodeStatus.end_states()
+        ]
         plan = ScalePlan()
-        for node in self._worker_manager.init_nodes():
-            alive = existing.get(node.rank_index)
-            if alive is not None:
-                self._worker_manager.remove_node(node.id)
-                self._worker_manager.update_node(alive)
-                self._job_context.update_node(alive)
-                logger.info("adopted existing node %s", alive.name)
-            else:
-                self._job_context.update_node(node)
-                plan.launch_nodes.append(node)
+        for node_type, manager in self._managers.items():
+            existing = {
+                n.rank_index: n
+                for n in backend_nodes
+                if n.type == node_type
+            }
+            for node in manager.init_nodes():
+                alive = existing.get(node.rank_index)
+                if alive is not None:
+                    manager.remove_node(node.id)
+                    manager.update_node(alive)
+                    self._job_context.update_node(alive)
+                    logger.info("adopted existing node %s", alive.name)
+                else:
+                    self._job_context.update_node(node)
+                    plan.launch_nodes.append(node)
         if not plan.empty():
             self._scaler.scale(plan)
         self._job_context.set_job_stage(JobStage.RUNNING)
@@ -178,7 +195,7 @@ class DistributedJobManager:
         while not self._stopped.is_set():
             time.sleep(_MONITOR_INTERVAL_S)
             now = time.time()
-            for node in self._worker_manager.running_nodes():
+            for node in self._all_running_nodes():
                 if node.heartbeat_time <= 0:
                     continue
                 if now - node.heartbeat_time > self._heartbeat_timeout_s:
@@ -189,8 +206,21 @@ class DistributedJobManager:
                     )
                     self._observe_failure(node, NodeExitReason.KILLED)
 
+    def _all_running_nodes(self):
+        nodes = []
+        for manager in self._managers.values():
+            nodes.extend(manager.running_nodes())
+        return nodes
+
+    def _manager_of(self, node: Node):
+        return self._managers.get(node.type, self._worker_manager)
+
     def pending_timed_out(self) -> bool:
-        since = self._worker_manager.first_pending_since()
+        times = [
+            m.first_pending_since() for m in self._managers.values()
+        ]
+        times = [t for t in times if t]
+        since = min(times) if times else 0.0
         return bool(since) and (time.time() - since) > self._pending_timeout_s
 
     # ---- event processing ----------------------------------------------------
@@ -221,12 +251,16 @@ class DistributedJobManager:
 
     def _process_event_locked(self, event: NodeEvent):
         observed = event.node
-        node = self._worker_manager.get_node(observed.id)
+        node = None
+        for manager in self._managers.values():
+            node = manager.get_node(observed.id)
+            if node is not None:
+                break
         if node is None:
             # A node created outside our records (e.g. scaler raced the
-            # watcher at startup): adopt it.
+            # watcher at startup): adopt it under its role's manager.
             node = observed
-            self._worker_manager.update_node(node)
+            self._manager_of(node).update_node(node)
         node.host_name = observed.host_name or node.host_name
         node.host_ip = observed.host_ip or node.host_ip
         if observed.exit_reason:
@@ -295,6 +329,7 @@ class DistributedJobManager:
     def _handle_node_gone(self, node: Node):
         if (
             self._node_group_size > 1
+            and node.type == NodeType.WORKER
             and node.node_group >= 0
             and node.exit_reason == NodeExitReason.HARDWARE_ERROR
             and self._should_relaunch(node)
@@ -306,7 +341,7 @@ class DistributedJobManager:
             self._relaunch_node_group(node.node_group)
             return
         if self._should_relaunch(node):
-            new_node, plan = self._worker_manager.relaunch_node(node)
+            new_node, plan = self._manager_of(node).relaunch_node(node)
             if new_node is not None:
                 logger.info(
                     "relaunching %s as %s (attempt %d/%d)",
@@ -390,19 +425,29 @@ class DistributedJobManager:
     def _resolve_node(self, reported_id: int) -> Optional[Node]:
         """Map an agent-reported node id to the master's record, via the
         alias recorded at join time if the ids diverged."""
-        node = self._worker_manager.get_node(reported_id)
-        if node is not None:
-            return node
-        actual = self._id_alias.get(reported_id)
-        if actual is not None:
-            return self._worker_manager.get_node(actual)
+        for node_id in (reported_id, self._id_alias.get(reported_id)):
+            if node_id is None:
+                continue
+            for manager in self._managers.values():
+                node = manager.get_node(node_id)
+                if node is not None:
+                    return node
         return None
 
     def handle_node_joined(self, node_id: int, node_rank: int):
-        node = self._worker_manager.get_node(node_id)
+        # Direct id lookup across EVERY role manager first — a chief or
+        # evaluator agent must never be mis-attributed to a same-rank
+        # worker record.
+        node = None
+        for manager in self._managers.values():
+            node = manager.get_node(node_id)
+            if node is not None:
+                break
         if node is None:
             # Agent ids are assigned by the backend; match the newest
-            # live incarnation of the rank and remember the alias.
+            # live incarnation of the rank and remember the alias. Only
+            # workers use backend-assigned ids this way (their ranks
+            # come from the elastic rendezvous protocol).
             candidates = [
                 n
                 for n in self._worker_manager.nodes.values()
@@ -487,14 +532,15 @@ class DistributedJobManager:
 
     def get_job_detail(self) -> comm.JobDetailResponse:
         nodes = {}
-        for node_id, node in self._worker_manager.nodes.items():
-            nodes[node_id] = {
-                "type": node.type,
-                "rank": node.rank_index,
-                "status": node.status,
-                "relaunch_count": node.relaunch_count,
-                "host": node.host_name,
-            }
+        for manager in self._managers.values():
+            for node_id, node in manager.nodes.items():
+                nodes[node_id] = {
+                    "type": node.type,
+                    "rank": node.rank_index,
+                    "status": node.status,
+                    "relaunch_count": node.relaunch_count,
+                    "host": node.host_name,
+                }
         return comm.JobDetailResponse(
             job_name=self._job_name,
             stage=self._job_context.job_stage,
@@ -503,14 +549,29 @@ class DistributedJobManager:
 
     # ---- run-loop queries ----------------------------------------------------
 
+    def _success_gating_managers(self):
+        """Roles whose completion gates job success: workers and the
+        chief. Evaluators are auxiliary — a finished training job tears
+        them down rather than waiting on them."""
+        return [
+            m
+            for t, m in self._managers.items()
+            if t in (NodeType.WORKER, NodeType.CHIEF)
+        ]
+
     def all_workers_exited(self) -> bool:
-        return self._worker_manager.all_nodes_exited()
+        return all(
+            m.all_nodes_exited() for m in self._success_gating_managers()
+        )
 
     def all_workers_succeeded(self) -> bool:
-        return self._worker_manager.all_nodes_succeeded()
+        return all(
+            m.all_nodes_succeeded()
+            for m in self._success_gating_managers()
+        )
 
     def all_running_node_hanged(self) -> bool:
-        running = self._worker_manager.running_nodes()
+        running = self._all_running_nodes()
         if not running:
             return False
         now = time.time()

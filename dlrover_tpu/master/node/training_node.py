@@ -232,3 +232,79 @@ class WorkerManager(TrainingNodeManager):
         if node.finish_time is None:
             return False
         return (time.time() - node.finish_time) < window_s
+
+
+class ChiefManager(TrainingNodeManager):
+    """Chief-role manager (reference master/node/training_node.py chief
+    handling): the coordinating host. Chief nodes are CRITICAL — they
+    gate job success alongside workers, and the relaunch path treats
+    their loss with the same urgency as a worker world re-formation
+    (in JAX SPMD the rendezvous re-forms the world either way; the
+    chief's criticality mainly drives reporting and success gating)."""
+
+    def __init__(
+        self,
+        group_resource: NodeGroupResource,
+        new_node_id_fn,
+        max_relaunch_count: int = 3,
+    ):
+        super().__init__(
+            NodeType.CHIEF,
+            group_resource,
+            new_node_id_fn,
+            max_relaunch_count,
+        )
+
+    def init_nodes(self) -> List[Node]:
+        nodes = super().init_nodes()
+        for node in nodes:
+            node.critical = True
+        return nodes
+
+
+class EvaluatorManager(TrainingNodeManager):
+    """Evaluator-role manager (reference master/node/evaluator.py): a
+    side group running evaluations off checkpoints. Evaluators relaunch
+    like workers but do NOT gate job success — a finished training job
+    with a still-running evaluator succeeds and the evaluator is torn
+    down with the job."""
+
+    def __init__(
+        self,
+        group_resource: NodeGroupResource,
+        new_node_id_fn,
+        max_relaunch_count: int = 3,
+    ):
+        super().__init__(
+            NodeType.EVALUATOR,
+            group_resource,
+            new_node_id_fn,
+            max_relaunch_count,
+        )
+
+
+def create_role_manager(
+    node_type: str,
+    group_resource: NodeGroupResource,
+    new_node_id_fn,
+    max_relaunch_count: int = 3,
+    node_group_size: int = 0,
+):
+    if node_type == NodeType.WORKER:
+        return WorkerManager(
+            group_resource,
+            new_node_id_fn,
+            max_relaunch_count,
+            node_group_size=node_group_size,
+        )
+    if node_type == NodeType.CHIEF:
+        return ChiefManager(
+            group_resource, new_node_id_fn, max_relaunch_count
+        )
+    if node_type == NodeType.EVALUATOR:
+        return EvaluatorManager(
+            group_resource, new_node_id_fn, max_relaunch_count
+        )
+    return TrainingNodeManager(
+        node_type, group_resource, new_node_id_fn, max_relaunch_count
+    )

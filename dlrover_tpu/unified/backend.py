@@ -400,6 +400,9 @@ class RayBackend(Backend):
             logger.warning(
                 "ray actor %s is dead; reporting failed", handle.actor_name
             )
+            # Actor names are reused across relaunches: the replacement
+            # must start with a clean miss budget.
+            self._inconclusive.pop(handle.actor_name, None)
             return 1
         except Exception:
             # Transient control-plane trouble (GetTimeoutError, brief
@@ -427,6 +430,7 @@ class RayBackend(Backend):
             return None
 
     def stop_worker(self, handle, timeout: float = 10.0):
+        self._inconclusive.pop(handle.actor_name, None)
         try:
             self._ray.get(
                 handle.actor.stop.remote(timeout), timeout=timeout + 30

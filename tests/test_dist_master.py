@@ -258,3 +258,60 @@ def test_master_restart_adopts_existing_nodes():
         assert ranks == [0, 1]
     finally:
         mgr2.stop()
+
+
+def test_multi_role_evaluator_and_chief():
+    """Per-role managers (reference worker/chief/evaluator side-by-side):
+    evaluators relaunch independently and never gate job success; the
+    chief gates success and is marked critical."""
+    cluster = SimCluster()
+    mgr = DistributedJobManager(
+        job_name="roles-job",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=2, node_resource=NodeResource(tpu_chips=4)
+            ),
+            NodeType.EVALUATOR: NodeGroupResource(
+                count=1, node_resource=NodeResource()
+            ),
+            NodeType.CHIEF: NodeGroupResource(
+                count=1, node_resource=NodeResource()
+            ),
+        },
+        scaler=SimScaler("roles-job", cluster),
+        watcher=SimNodeWatcher("roles-job", cluster),
+    )
+    try:
+        mgr.start()
+        assert wait_until(
+            lambda: len(
+                [n for n in mgr._all_running_nodes()]
+            ) == 4
+        )
+        chief = [
+            n
+            for n in mgr._managers[NodeType.CHIEF].nodes.values()
+        ][0]
+        assert chief.critical
+
+        # Evaluator crash: relaunched by ITS manager; workers untouched.
+        ev_mgr = mgr._managers[NodeType.EVALUATOR]
+        ev = list(ev_mgr.nodes.values())[0]
+        cluster.fail_node(ev.id)
+        assert wait_until(
+            lambda: any(
+                n.id != ev.id and n.status == NodeStatus.RUNNING
+                for n in ev_mgr.nodes.values()
+            )
+        )
+        assert len(mgr.worker_manager.nodes) == 2
+
+        # Workers + chief succeed -> job succeeds even though the
+        # evaluator still runs.
+        for node in mgr.worker_manager.nodes.values():
+            cluster.succeed_node(node.id)
+        cluster.succeed_node(chief.id)
+        assert wait_until(mgr.all_workers_succeeded)
+        assert mgr.all_workers_exited()
+    finally:
+        mgr.stop()
