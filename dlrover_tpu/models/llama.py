@@ -50,6 +50,19 @@ class TpuLMConfig:
     pp_stages: int = 1
     num_microbatches: int = 1
     remat: bool = True
+    # "dots": selective rematerialization — matmul outputs are saved,
+    # only elementwise work recomputes in the backward (measured +2 MFU
+    # points over full remat on v5e at the bench config). "full":
+    # recompute everything (lowest memory; the hyperparam strategy
+    # escalates to this on OOM evidence).
+    remat_policy: str = "dots"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("dots", "full"):
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} not in ('dots', "
+                f"'full') — a typo here silently costs MFU"
+            )
 
     @property
     def layers_per_stage(self) -> int:
@@ -321,7 +334,12 @@ def run_layer_stack(
         return y, aux
 
     if config.remat:
-        body = jax.checkpoint(body)
+        policy = None
+        if config.remat_policy == "dots":
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        body = jax.checkpoint(body, policy=policy)
     x, auxes = jax.lax.scan(body, x, layer_params)
     return x, jnp.sum(auxes)
 
