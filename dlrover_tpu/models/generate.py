@@ -1,0 +1,185 @@
+"""Autoregressive decoding for TpuLM: KV-cache prefill + generate.
+
+TPU-shaped: the whole decode loop is ONE jitted ``lax.scan`` — static
+shapes (cache pre-allocated at ``max_len``), no per-token dispatch, and
+position-masked attention over the cache so padding never leaks into
+the softmax. The cache layout [layers, batch, max_len, kv_heads,
+head_dim] keeps the per-step update a ``dynamic_update_slice`` on the
+time axis and shards like activations (kv_heads on tp, batch on dp).
+
+The decode layer is BUILT FROM the training layer's own blocks
+(llama.attention_qkv / attention_out / mlp_block) plus the shared
+``dot_product_attention`` — only the cache append is decode-specific,
+so training and generation cannot drift. Compiled programs are cached
+per (config, shapes, temperature), so repeated generate() calls retrace
+nothing.
+
+    state = ... (restored params)
+    out = generate(cfg, params, prompt_tokens, max_new_tokens=64)
+"""
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.ops.attention import dot_product_attention
+
+
+class DecodeCache(NamedTuple):
+    k: jnp.ndarray  # [layers, b, max_len, kv_heads, head_dim]
+    v: jnp.ndarray
+    length: jnp.ndarray  # [] int32 — tokens filled so far
+
+
+def init_cache(
+    config: llama.TpuLMConfig, batch: int, max_len: int
+) -> DecodeCache:
+    if config.pp_stages > 1:
+        raise NotImplementedError(
+            "decode runs on the flat layer stack; merge pipeline stages "
+            "for inference"
+        )
+    shape = (
+        config.n_layers,
+        batch,
+        max_len,
+        config.n_kv_heads,
+        config.head_dim,
+    )
+    dtype = config.compute_dtype
+    return DecodeCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
+    """One decoder block over [b, sq] new tokens with cache append.
+    Returns (x, new_k_cache, new_v_cache)."""
+    residual = x
+    q, k, v = llama.attention_qkv(config, p, x, positions)
+    # Append the new tokens' K/V at the cache cursor.
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
+    )
+    # Attention over the full pre-allocated cache: with contiguous query
+    # positions (max q_pos == new length - 1), the causal mask already
+    # excludes every unfilled slot.
+    max_len = k_cache.shape[1]
+    attn = dot_product_attention(
+        q,
+        k_cache,
+        v_cache,
+        causal=True,
+        q_positions=positions,
+        kv_positions=jnp.arange(max_len),
+    )
+    x = llama.attention_out(config, p, attn, residual)
+    x, _ = llama.mlp_block(config, p, x)
+    return x, k_cache, v_cache
+
+
+def _forward_with_cache(config, params, tokens, cache: DecodeCache):
+    """Run [b, sq] tokens through all layers, appending to the cache.
+    Returns (logits of the LAST position [b, vocab], new cache)."""
+    b, sq = tokens.shape
+    positions = cache.length + jnp.broadcast_to(
+        jnp.arange(sq, dtype=jnp.int32), (b, sq)
+    )
+    x = llama.embed_tokens(config, params, tokens)
+
+    def body(carry, layer_in):
+        pl, k_c, v_c = layer_in
+        y, k_c, v_c = _layer_decode(
+            config, pl, carry, positions, k_c, v_c, cache.length
+        )
+        return y, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    logits = llama.unembed(config, params, x[:, -1:, :])[:, 0, :]
+    new_cache = DecodeCache(k=new_k, v=new_v, length=cache.length + sq)
+    return logits, new_cache
+
+
+class GenerateResult(NamedTuple):
+    tokens: jnp.ndarray       # [b, max_new_tokens]
+    cache: DecodeCache
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_generate(
+    config: llama.TpuLMConfig,
+    batch: int,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float,
+):
+    """One compiled program per (config, shapes, temperature) — repeat
+    generate() calls reuse it (jit caches key on the function object,
+    which must therefore be cached itself)."""
+
+    def pick(logits, rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    def run(params, prompt, rng):
+        cache = init_cache(config, batch, max_len)
+        logits, cache = _forward_with_cache(config, params, prompt, cache)
+        rng, first_key = jax.random.split(rng)
+        first = pick(logits, first_key)
+
+        def step(carry, _):
+            cache, tok, rng = carry
+            rng, sub = jax.random.split(rng)
+            logits, cache = _forward_with_cache(
+                config, params, tok[:, None], cache
+            )
+            nxt = pick(logits, sub)
+            return (cache, nxt, rng), tok
+
+        (cache, last, _), toks = jax.lax.scan(
+            step, (cache, first, rng), None, length=max_new_tokens - 1
+        )
+        out = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+        )
+        return out, cache
+
+    return jax.jit(run)
+
+
+def generate(
+    config: llama.TpuLMConfig,
+    params,
+    prompt,                    # [b, prompt_len] int32
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> GenerateResult:
+    """Greedy (temperature=0) or sampled decoding. The prefill and the
+    whole decode loop are one jit-compiled program with static shapes."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    b, prompt_len = prompt.shape
+    max_len = max_len or (prompt_len + max_new_tokens)
+    if max_len < prompt_len + max_new_tokens:
+        raise ValueError("max_len too small for prompt + new tokens")
+    rng = rng if rng is not None else jax.random.key(0)
+    run = _compiled_generate(
+        config, b, max_new_tokens, max_len, float(temperature)
+    )
+    tokens, cache = run(params, prompt, rng)
+    return GenerateResult(tokens=tokens, cache=cache)

@@ -243,23 +243,12 @@ def default_attention_fn():
     return _ATTN_CACHE[choice]
 
 
-def transformer_layer(
-    config: TpuLMConfig,
-    layer_params: Dict[str, jnp.ndarray],
-    x,
-    positions,
-    attention_fn=None,
-):
-    """One decoder block. x: [b, s, d]; positions: [b, s] global indices.
+def attention_qkv(config: TpuLMConfig, p, x, positions):
+    """Pre-attention block: norm + QKV projections + RoPE.
 
-    Returns (x, moe_aux_losses or None).
-    """
+    Shared by the training layer and the KV-cache decode path
+    (models/generate.py) so the two can never drift."""
     cdt = config.compute_dtype
-    p = layer_params
-    attn_fn = attention_fn or dot_product_attention
-
-    # --- attention ------------------------------------------------------
-    residual = x
     hx = rms_norm(x, p["attn_norm"]).astype(cdt)
     q = jnp.einsum("bsd,dhk->bshk", hx, p["wq"].astype(cdt))
     k = jnp.einsum("bsd,dhk->bshk", hx, p["wk"].astype(cdt))
@@ -268,13 +257,21 @@ def transformer_layer(
     k = with_logical_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
-    attn = attn_fn(q, k, v, causal=True,
-                   q_positions=positions, kv_positions=positions)
-    out = jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(cdt))
-    x = residual + out.astype(x.dtype)
-    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+    return q, k, v
 
-    # --- mlp ------------------------------------------------------------
+
+def attention_out(config: TpuLMConfig, p, attn, residual):
+    """Post-attention projection + residual add (shared with decode)."""
+    cdt = config.compute_dtype
+    out = jnp.einsum("bshk,hkd->bsd", attn, p["wo"].astype(cdt))
+    x = residual + out.astype(residual.dtype)
+    return with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+def mlp_block(config: TpuLMConfig, p, x):
+    """Residual MLP (dense or MoE). Returns (x, aux). Shared with the
+    decode path."""
+    cdt = config.compute_dtype
     residual = x
     hx = rms_norm(x, p["mlp_norm"]).astype(cdt)
     if config.n_experts > 0:
@@ -299,6 +296,28 @@ def transformer_layer(
     x = residual + out.astype(x.dtype)
     x = with_logical_constraint(x, ("batch", "seq", "embed"))
     return x, aux
+
+
+def transformer_layer(
+    config: TpuLMConfig,
+    layer_params: Dict[str, jnp.ndarray],
+    x,
+    positions,
+    attention_fn=None,
+):
+    """One decoder block. x: [b, s, d]; positions: [b, s] global indices.
+
+    Returns (x, moe_aux_losses or None).
+    """
+    p = layer_params
+    attn_fn = attention_fn or dot_product_attention
+
+    residual = x
+    q, k, v = attention_qkv(config, p, x, positions)
+    attn = attn_fn(q, k, v, causal=True,
+                   q_positions=positions, kv_positions=positions)
+    x = attention_out(config, p, attn, residual)
+    return mlp_block(config, p, x)
 
 
 def embed_tokens(config, params, tokens):

@@ -26,18 +26,30 @@ from dlrover_tpu.ops.attention import NEG_INF
 from dlrover_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
 
 
-def _block_attn(q, k, v, q_pos, kv_pos, causal):
+def _block_attn(q, k, v, q_pos, kv_pos, causal, scale):
     """Partial attention of q against one K/V block.
 
     q: [b, sq, h, d]; k/v: [b, skv, hkv, d]. Returns (o, m, l) where
     o = sum(exp(logits - m) @ v), m = rowwise max logits, l = rowwise
     sum exp — the flash-attention partial triple, f32.
+
+    Matmuls keep the input dtype (bf16 = full-rate MXU) and accumulate
+    in f32; softmax math runs on the f32 logits with the scale applied
+    there, so bf16 inputs lose nothing to a pre-scaled q.
     """
     b, sq, h, d = q.shape
     _, skv, hkv, _ = k.shape
     groups = h // hkv
-    qg = q.astype(jnp.float32).reshape(b, sq, hkv, groups, d)
-    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, k.astype(jnp.float32))
+    qg = q.reshape(b, sq, hkv, groups, d)
+    logits = (
+        jnp.einsum(
+            "bqkgd,bskd->bkgqs",
+            qg,
+            k,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
     if causal:
         mask = q_pos[:, :, None] >= kv_pos[:, None, :]  # [b, sq, skv]
         logits = jnp.where(mask[:, None, None], logits, NEG_INF)
@@ -45,7 +57,12 @@ def _block_attn(q, k, v, q_pos, kv_pos, causal):
     p = jnp.exp(logits - m[..., None])
     p = jnp.where((m > NEG_INF / 2)[..., None], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bkgqs,bskd->bkgqd", p, v.astype(jnp.float32))
+    o = jnp.einsum(
+        "bkgqs,bskd->bkgqd",
+        p.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.float32)
     return o, m, l
 
 
@@ -68,7 +85,6 @@ def ring_attention_local(
     groups = h // hkv
     n = jax.lax.axis_size(axis_name)
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
-    q = q * scale
 
     o0 = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
     m0 = jnp.full((b, hkv, groups, sq), NEG_INF, jnp.float32)
@@ -77,7 +93,9 @@ def ring_attention_local(
 
     def step(i, carry):
         o, m, l, k_cur, v_cur, kv_pos = carry
-        bo, bm, bl = _block_attn(q, k_cur, v_cur, q_positions, kv_pos, causal)
+        bo, bm, bl = _block_attn(
+            q, k_cur, v_cur, q_positions, kv_pos, causal, scale
+        )
         m_new = jnp.maximum(m, bm)
         corr = jnp.exp(m - m_new)
         bcorr = jnp.exp(bm - m_new)
