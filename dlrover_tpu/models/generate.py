@@ -10,9 +10,16 @@ time axis and shards like activations (kv_heads on tp, batch on dp).
 The decode layer is BUILT FROM the training layer's own blocks
 (llama.attention_qkv / attention_out / mlp_block) plus the shared
 ``dot_product_attention`` — only the cache append is decode-specific,
-so training and generation cannot drift. Compiled programs are cached
-per (config, shapes, temperature), so repeated generate() calls retrace
-nothing.
+so dense-model training and generation cannot drift. Compiled programs
+are cached per (config, shapes, temperature), so repeated generate()
+calls retrace nothing.
+
+MoE caveat: expert capacity is derived from the LOCAL sequence length
+of each call (models/moe.py expert_capacity), so token-drop behavior
+differs between a full teacher-forced forward and prefill+decode —
+single-token decode steps clamp capacity to 1 and never drop. This is
+the standard train/infer capacity asymmetry of capacity-factor MoE,
+not a bug; exact logit parity holds for dense configs only.
 
     state = ... (restored params)
     out = generate(cfg, params, prompt_tokens, max_new_tokens=64)
@@ -177,6 +184,10 @@ def generate(
     max_len = max_len or (prompt_len + max_new_tokens)
     if max_len < prompt_len + max_new_tokens:
         raise ValueError("max_len too small for prompt + new tokens")
+    if temperature > 0.0 and rng is None:
+        # A silent fixed default would make every sampled call return
+        # identical tokens (best-of-n sampling quietly broken).
+        raise ValueError("temperature > 0 requires an explicit rng key")
     rng = rng if rng is not None else jax.random.key(0)
     run = _compiled_generate(
         config, b, max_new_tokens, max_len, float(temperature)

@@ -1,0 +1,92 @@
+"""KV-cache generation from a flash checkpoint.
+
+Run (after any training run that saved flash checkpoints, e.g.
+examples/train_llama.py):
+
+    python examples/generate_demo.py --ckpt-dir /tmp/llama_ckpt
+
+What this demonstrates:
+- restoring params straight from a flash checkpoint (the same bytes
+  the elastic trainer saves — no conversion step);
+- one-jit autoregressive decoding (prefill + scan) with greedy and
+  sampled variants, compiled once and reused across calls.
+
+Without a checkpoint it falls back to random init so the demo always
+runs.
+"""
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ns = ap.parse_args()
+
+    from dlrover_tpu.models import generate as gen
+    from dlrover_tpu.models import llama
+
+    cfg = llama.TpuLMConfig(
+        vocab_size=4096,
+        embed_dim=256,
+        n_layers=4,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=32,
+        mlp_dim=1024,
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    params = None
+    if ns.ckpt_dir:
+        from dlrover_tpu.flash_ckpt.checkpointer import Checkpointer
+
+        ckpt = Checkpointer(ns.ckpt_dir, standalone=True)
+        restored = ckpt.load_checkpoint(to_device=False)
+        ckpt.close()
+        if restored is not None:
+            step, state, _ = restored
+            params = jax.tree_util.tree_map(
+                jnp.asarray, state["params"]
+            )
+            print(f"restored params from flash step {step}")
+    if params is None:
+        print("no checkpoint found; using random init")
+        params, _ = llama.init_params(cfg, jax.random.key(0))
+
+    prompt = jax.random.randint(
+        jax.random.key(1), (2, ns.prompt_len), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+
+    t0 = time.time()
+    greedy = gen.generate(cfg, params, prompt, ns.max_new)
+    jax.block_until_ready(greedy.tokens)
+    print(
+        f"greedy {greedy.tokens.shape} in {time.time() - t0:.2f}s "
+        f"(includes compile)"
+    )
+    t0 = time.time()
+    sampled = gen.generate(
+        cfg,
+        params,
+        prompt,
+        ns.max_new,
+        temperature=ns.temperature,
+        rng=jax.random.key(42),
+    )
+    jax.block_until_ready(sampled.tokens)
+    tok_s = 2 * ns.max_new / (time.time() - t0)
+    print(f"sampled {sampled.tokens.shape}: {tok_s:.0f} tok/s")
+    print("greedy[0][:16] =", [int(t) for t in greedy.tokens[0][:16]])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

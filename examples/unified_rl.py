@@ -1,0 +1,72 @@
+"""Multi-role (RL-style) job on the unified layer.
+
+Run:
+
+    python examples/unified_rl.py
+
+What this demonstrates:
+- the DLJobBuilder RL sugar (actor/rollout/reward roles);
+- collocation: actor + rollout packed onto the same node slot
+  (STRICT_PACK bundles; on Ray each slot becomes a placement group);
+- per-role SubMasters supervising their workers with gang restart —
+  the rollout role is marked elastic, so losing one member re-forms
+  the whole role;
+- manager self-failover state: worker records persist to
+  ``--state`` so a restarted driver re-attaches to live workers.
+
+The worker entrypoints here are tiny self-contained functions (module
+``examples.unified_rl`` run with ``:role_main``) that write progress
+files; swap them for real JAX programs — the role env
+(DLROVER_TPU_ROLE / ROLE_RANK / ROLE_WORLD_SIZE / NODE_SLOT) carries
+each process's coordinates.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def role_main():
+    """Shared toy entrypoint: identify the role, do 'work', exit 0."""
+    import time
+
+    role = os.environ["DLROVER_TPU_ROLE"]
+    rank = os.environ["DLROVER_TPU_ROLE_RANK"]
+    slot = os.environ.get("DLROVER_TPU_NODE_SLOT", "-1")
+    out = os.environ.get("RL_DEMO_OUT", tempfile.gettempdir())
+    time.sleep(0.5)
+    with open(os.path.join(out, f"{role}-{rank}.done"), "w") as f:
+        f.write(f"slot={slot}\n")
+    print(f"[{role}:{rank}] done on slot {slot}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--state", default="/tmp/unified_rl_state.json")
+    ap.add_argument("--out", default=None)
+    ns = ap.parse_args()
+    out = ns.out or tempfile.mkdtemp(prefix="unified_rl_")
+
+    from dlrover_tpu.unified import DLJobBuilder, submit
+
+    job = (
+        DLJobBuilder("rl-demo")
+        .nnodes(2)
+        .actor("examples.unified_rl:role_main").total(2)
+        .env("RL_DEMO_OUT", out).add()
+        .rollout("examples.unified_rl:role_main").total(2)
+        .env("RL_DEMO_OUT", out).elastic().add()
+        .reward("examples.unified_rl:role_main").total(1)
+        .env("RL_DEMO_OUT", out).failover("ignore").add()
+        .with_collocation("actor", "rollout")
+        .master_state(ns.state)
+        .build()
+    )
+    master = submit(job)
+    print("job finished:", master.status())
+    print("artifacts:", sorted(os.listdir(out)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,8 +88,21 @@ def test_sampled_generate_reproducible(tiny):
 
 
 def test_moe_decode_smoke():
+    # MoE decode runs but is NOT logit-identical to the teacher-forced
+    # forward: expert capacity derives from each call's local sequence
+    # length (the standard capacity-factor train/infer asymmetry), so
+    # only shape/execution is asserted here.
     cfg = llama.tiny_config(n_experts=4, moe_top_k=2)
     params, _ = llama.init_params(cfg, jax.random.key(0))
     prompt = jnp.zeros((1, 3), jnp.int32)
     result = gen.generate(cfg, params, prompt, 3)
     assert result.tokens.shape == (1, 3)
+
+
+def test_sampling_requires_rng():
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="rng"):
+        gen.generate(
+            cfg, params, jnp.zeros((1, 2), jnp.int32), 2, temperature=1.0
+        )
