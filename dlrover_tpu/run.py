@@ -22,6 +22,10 @@ from typing import Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.monitor import ResourceMonitor
+from dlrover_tpu.agent.training_monitor import (
+    METRICS_FILE_ENV,
+    TrainingMonitor,
+)
 from dlrover_tpu.agent.training import ElasticAgent, RunResult, WorkerSpec
 from dlrover_tpu.common.constants import (
     NodeEnv,
@@ -192,6 +196,15 @@ def run(args) -> int:
     monitor = ResourceMonitor(client)
     monitor.start()
 
+    # Metrics-file step reporting (reference TorchTrainingMonitor): a
+    # training loop that never talks RPC still feeds goodput accounting
+    # by appending JSON lines to DLROVER_TPU_METRICS_FILE.
+    training_monitor = None
+    metrics_path = os.getenv(METRICS_FILE_ENV, "")
+    if metrics_path:
+        training_monitor = TrainingMonitor(client, metrics_path)
+        training_monitor.start()
+
     from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
 
     paral_tuner = ParalConfigTuner(client)
@@ -300,12 +313,22 @@ def run(args) -> int:
     def _signal_handler(signum, frame):
         logger.info("launcher received signal %d; stopping workers", signum)
         agent.stop()
+        if training_monitor is not None:
+            # Preemption is exactly when the final steps matter for
+            # goodput accounting: flush them before dying.
+            try:
+                training_monitor.poll_once()
+            except Exception:
+                pass
         sys.exit(128 + signum)
 
     signal.signal(signal.SIGTERM, _signal_handler)
 
     result = agent.run()
     monitor.stop()
+    if training_monitor is not None:
+        training_monitor.poll_once()  # flush the final steps
+        training_monitor.stop()
     paral_tuner.stop()
     for c in timer_collectors:
         c.stop()

@@ -204,3 +204,102 @@ def test_fetch_task_polls_through_wait(master, client):
     assert not t.is_alive()
     assert result["task"] is None  # dataset completed
     c2.close()
+
+
+def test_metrics_file_training_monitor(tmp_path):
+    """Zero-RPC step reporting (reference TorchTrainingMonitor): the
+    worker appends JSON lines, the agent-side tail reports the newest
+    step to the master."""
+    import json as _json
+    import os
+
+    from dlrover_tpu.agent.training_monitor import (
+        TrainingMonitor,
+        report_step,
+    )
+
+    class FakeClient:
+        def __init__(self):
+            self.reports = []
+
+        def report_global_step(self, step, elapsed):
+            self.reports.append((step, elapsed))
+
+    path = str(tmp_path / "metrics.jsonl")
+    client = FakeClient()
+    mon = TrainingMonitor(client, path, interval=3600)
+
+    # Nothing yet: no file.
+    assert mon.poll_once() is None
+
+    os.environ["DLROVER_TPU_METRICS_FILE"] = path
+    try:
+        for s in (1, 2, 3):
+            report_step(s, loss=3.2)
+    finally:
+        del os.environ["DLROVER_TPU_METRICS_FILE"]
+    assert mon.poll_once() == 3
+    assert client.reports[-1][0] == 3
+
+    # Partial (mid-write) lines are left for the next poll.
+    with open(path, "a") as f:
+        f.write(_json.dumps({"step": 4, "ts": 1.0}))  # no newline
+    assert mon.poll_once() is None
+    with open(path, "a") as f:
+        f.write("\n")
+    assert mon.poll_once() == 4
+
+    # Truncation (restarted worker) restarts the tail cleanly.
+    with open(path, "w") as f:
+        f.write(_json.dumps({"step": 5, "ts": 2.0}) + "\n")
+    assert mon.poll_once() == 5
+    assert [s for s, _ in client.reports] == [3, 4, 5]
+
+
+def test_training_monitor_truncation_resets_watermark(tmp_path):
+    """A restarted worker replaying from its checkpoint (smaller steps,
+    truncated file) must be reported again, not read as frozen."""
+    import json as _json
+
+    from dlrover_tpu.agent.training_monitor import TrainingMonitor
+
+    class FakeClient:
+        def __init__(self):
+            self.steps = []
+
+        def report_global_step(self, step, elapsed):
+            self.steps.append(step)
+
+    path = str(tmp_path / "m.jsonl")
+    client = FakeClient()
+    mon = TrainingMonitor(client, path, interval=3600)
+    with open(path, "w") as f:
+        f.write(_json.dumps({"step": 100, "ts": 1.0}) + "\n")
+    assert mon.poll_once() == 100
+    # restart: file recreated, resumed at step 50
+    with open(path, "w") as f:
+        f.write(_json.dumps({"step": 50, "ts": 2.0}) + "\n")
+    assert mon.poll_once() == 50
+    assert client.steps == [100, 50]
+
+
+def test_training_monitor_non_ascii_lines(tmp_path):
+    from dlrover_tpu.agent.training_monitor import TrainingMonitor
+
+    class FakeClient:
+        def __init__(self):
+            self.steps = []
+
+        def report_global_step(self, step, elapsed):
+            self.steps.append(step)
+
+    path = str(tmp_path / "m.jsonl")
+    client = FakeClient()
+    mon = TrainingMonitor(client, path, interval=3600)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"step": 1, "ts": 1.0, "tag": "ünïcödé-δ"}\n')
+    assert mon.poll_once() == 1
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"step": 2, "ts": 2.0}\n')
+    assert mon.poll_once() == 2  # byte offsets: no re-framing drift
+    assert client.steps == [1, 2]
