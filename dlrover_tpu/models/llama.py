@@ -16,6 +16,7 @@ machinery has a real workload to supervise.
 """
 
 import dataclasses
+import functools
 import math
 import os
 from typing import Any, Dict, Tuple
@@ -50,18 +51,22 @@ class TpuLMConfig:
     pp_stages: int = 1
     num_microbatches: int = 1
     remat: bool = True
+    # "mlp_only": the attention half of each layer is NOT rematerialized
+    #   (its Pallas flash kernel would otherwise re-run in the backward —
+    #   a measured ~1ms/layer/step on v5e) while the MLP half keeps the
+    #   "dots" policy. Costs ~+130MB/layer of saved attention residuals.
     # "dots": selective rematerialization — matmul outputs are saved,
-    # only elementwise work recomputes in the backward (measured +2 MFU
-    # points over full remat on v5e at the bench config). "full":
-    # recompute everything (lowest memory; the hyperparam strategy
-    # escalates to this on OOM evidence).
-    remat_policy: str = "dots"
+    #   only elementwise work recomputes in the backward (measured +2 MFU
+    #   points over full remat on v5e at the bench config).
+    # "full": recompute everything (lowest memory; the hyperparam
+    #   strategy escalates to this on OOM evidence).
+    remat_policy: str = "mlp_only"
 
     def __post_init__(self):
-        if self.remat_policy not in ("dots", "full"):
+        if self.remat_policy not in ("mlp_only", "dots", "full"):
             raise ValueError(
-                f"remat_policy {self.remat_policy!r} not in ('dots', "
-                f"'full') — a typo here silently costs MFU"
+                f"remat_policy {self.remat_policy!r} not in ('mlp_only', "
+                f"'dots', 'full') — a typo here silently costs MFU"
             )
 
     @property
@@ -327,8 +332,14 @@ def embed_tokens(config, params, tokens):
     return with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
+def final_hidden(config, params, x):
+    """Final-norm + compute-dtype cast — the single head path shared by
+    ``unembed`` and the fused-CE loss so they can never diverge."""
+    return rms_norm(x, params["final_norm"]).astype(config.compute_dtype)
+
+
 def unembed(config, params, x):
-    x = rms_norm(x, params["final_norm"]).astype(config.compute_dtype)
+    x = final_hidden(config, params, x)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"].astype(config.compute_dtype)
     )
@@ -346,21 +357,83 @@ def run_layer_stack(
 ):
     """scan over a [L, ...] stacked layer pytree (single pipeline stage)."""
 
-    def body(carry, pl):
-        y, aux = transformer_layer(
-            config, pl, carry, positions, attention_fn
-        )
-        return y, aux
+    dots_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
 
-    if config.remat:
-        policy = None
-        if config.remat_policy == "dots":
-            policy = (
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    # "mlp_only" exempts the attention call from remat on the premise
+    # that its saved residuals are O(s*d) — true for the flash kernel
+    # (custom VJP: q/k/v/out + compact lse) but NOT for plain XLA
+    # attention or other impls, whose backward would pin O(s^2) softmax
+    # intermediates per layer. Impls that keep O(s*d) residuals declare
+    # it via a ``saveable_residuals`` attribute; everything else demotes
+    # to the "dots" policy.
+    mlp_only = (
+        config.remat
+        and config.remat_policy == "mlp_only"
+        and getattr(attention_fn, "saveable_residuals", False)
+    )
+    if mlp_only:
+        # Only the flash-attention call itself escapes rematerialization
+        # (re-running its Pallas forward in the backward costs a measured
+        # ~1ms/layer/step on v5e). Both flanks keep the dots policy, so
+        # the extra saved state is just (q_roped, k_roped, v, attn_out)
+        # plus the compact lse — the pre-rope projections DCE away
+        # because rope's backward only needs the (recomputed) sin/cos.
+        attn_fn = attention_fn or dot_product_attention
+        ckpt_qkv = jax.checkpoint(
+            functools.partial(attention_qkv, config), policy=dots_policy
+        )
+
+        def out_mlp(p, attn, residual):
+            y = attention_out(config, p, attn, residual)
+            return mlp_block(config, p, y)
+
+        ckpt_out_mlp = jax.checkpoint(out_mlp, policy=dots_policy)
+
+        def body(carry, pl):
+            q, k, v = ckpt_qkv(pl, carry, positions)
+            attn = attn_fn(q, k, v, causal=True,
+                           q_positions=positions, kv_positions=positions)
+            return ckpt_out_mlp(pl, attn, carry)
+
+    else:
+        def body(carry, pl):
+            y, aux = transformer_layer(
+                config, pl, carry, positions, attention_fn
             )
-        body = jax.checkpoint(body, policy=policy)
+            return y, aux
+
+        if config.remat:
+            policy = (
+                dots_policy
+                if config.remat_policy in ("dots", "mlp_only")
+                else None
+            )
+            body = jax.checkpoint(body, policy=policy)
     x, auxes = jax.lax.scan(body, x, layer_params)
     return x, jnp.sum(auxes)
+
+
+def forward_hidden(
+    config: TpuLMConfig,
+    params,
+    tokens,                      # [b, s] int32
+    positions=None,              # [b, s] global positions
+    attention_fn=None,
+):
+    """Forward up to (but excluding) the final norm + unembedding.
+
+    Returns (hidden [b, s, d], aux_loss scalar). pp_stages must be 1 —
+    the pipelined path owns its own unembed placement.
+    """
+    if attention_fn is None and positions is None:
+        attention_fn = default_attention_fn()
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = embed_tokens(config, params, tokens)
+    return run_layer_stack(
+        config, params["layers"], x, positions, attention_fn
+    )
 
 
 def forward(
@@ -379,21 +452,15 @@ def forward(
     flash kernel on TPU. Callers with sharded/packed positions (ring
     attention, SP meshes) pass their own ``attention_fn``.
     """
-    if attention_fn is None and positions is None:
-        attention_fn = default_attention_fn()
     if config.pp_stages > 1:
+        if attention_fn is None and positions is None:
+            attention_fn = default_attention_fn()
         from dlrover_tpu.trainer.pipeline import pipelined_forward
 
         return pipelined_forward(
             config, params, tokens, positions, attention_fn
         )
-    b, s = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = embed_tokens(config, params, tokens)
-    x, aux = run_layer_stack(
-        config, params["layers"], x, positions, attention_fn
-    )
+    x, aux = forward_hidden(config, params, tokens, positions, attention_fn)
     return unembed(config, params, x), aux
 
 
@@ -417,11 +484,83 @@ def cross_entropy(logits, targets, mask=None, z_weight: float = 1e-4):
     return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def _fused_ce_mode() -> str:
+    """Parse DLROVER_TPU_FUSED_CE once: "on" | "off" | "auto".
+
+    Unrecognized values warn and fall back to auto instead of silently
+    flipping the CE path."""
+    raw = os.environ.get("DLROVER_TPU_FUSED_CE", "auto").lower()
+    if raw in ("on", "1", "fused", "true"):
+        return "on"
+    if raw in ("off", "0", "unfused", "false"):
+        return "off"
+    if raw != "auto":
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "DLROVER_TPU_FUSED_CE=%r not in (on, off, auto); using auto",
+            raw,
+        )
+    return "auto"
+
+
+def _fused_ce_applicable(config) -> bool:
+    """Fused CE handles pp == 1 and vocab-unsharded meshes. Under tensor
+    parallelism the vocab dim of lm_head is sharded — there the unfused
+    path is the right one anyway (GSPMD shards the logits matmul and
+    inserts the logsumexp psum); a blockwise dynamic-slice over a sharded
+    vocab would force per-block collectives instead."""
+    if config.pp_stages > 1:
+        return False
+    from dlrover_tpu.parallel.sharding import current_mesh, logical_to_spec
+
+    mesh = current_mesh()
+    if mesh is None:
+        return True
+    vocab_spec = logical_to_spec(("embed", "vocab"))[1]
+    if vocab_spec is None:
+        return True
+    axes = (vocab_spec,) if isinstance(vocab_spec, str) else vocab_spec
+    return all(dict(mesh.shape).get(a, 1) == 1 for a in axes)
+
+
 def loss_fn(config, params, batch, attention_fn=None):
-    """batch: {"tokens": [b,s+1]} — next-token LM loss."""
+    """batch: {"tokens": [b,s+1]} — next-token LM loss.
+
+    Uses the fused blockwise CE (ops/fused_ce.py) whenever applicable so
+    the [b, s, vocab] f32 logits never materialize; falls back to
+    ``forward`` + ``cross_entropy`` for pipelined or vocab-sharded runs.
+    Set DLROVER_TPU_FUSED_CE=off to force the unfused path.
+    """
     tokens = batch["tokens"][:, :-1]
     targets = batch["tokens"][:, 1:]
-    logits, aux = forward(config, params, tokens, attention_fn=attention_fn)
-    ce = cross_entropy(logits, targets, batch.get("mask"))
+    # Fused CE is a MEMORY lever, not a time one: on v5e the dense CE at
+    # the flagship shape is already compute-bound (measured 19ms dense vs
+    # 29ms fused — the flash-style recompute costs 5 matmul passes vs 3),
+    # so "auto" only engages it when the f32 logits would be prohibitive
+    # (> ~4GB, e.g. long-context SFT where dense simply OOMs).
+    mode = _fused_ce_mode()
+    logits_bytes = tokens.size * config.vocab_size * 4
+    use_fused = mode == "on" or (
+        mode == "auto" and logits_bytes > 4 * 1024**3
+    )
+    if use_fused and _fused_ce_applicable(config):
+        from dlrover_tpu.ops.fused_ce import fused_cross_entropy
+
+        x, aux = forward_hidden(
+            config, params, tokens, attention_fn=attention_fn
+        )
+        h = final_hidden(config, params, x)
+        ce = fused_cross_entropy(
+            h,
+            params["lm_head"].astype(config.compute_dtype),
+            targets,
+            batch.get("mask"),
+        )
+    else:
+        logits, aux = forward(
+            config, params, tokens, attention_fn=attention_fn
+        )
+        ce = cross_entropy(logits, targets, batch.get("mask"))
     loss = ce + config.moe_aux_weight * aux
     return loss, {"ce": ce, "aux": aux}

@@ -1,0 +1,452 @@
+"""Fused blockwise cross-entropy: the LM loss without the [N, V] logits.
+
+The baseline loss (models/llama.py ``cross_entropy``) materializes full
+f32 logits — at the flagship bench shape that is a 2 GB HBM round-trip
+per pass (forward write, logsumexp read, softmax write/read in the
+backward, plus the 2 GB value_and_grad residual). This op computes the
+identical token-mean ``nll + z_weight * logz^2`` loss by streaming the
+vocab in blocks with an online logsumexp, so only [block_n, block_v]
+tiles ever exist:
+
+- **Pallas path** (TPU): forward kernel with grid (n_tiles, v_tiles),
+  v innermost; running (m, l, target_logit) live in VMEM scratch across
+  v iterations (same sequential-grid trick as ops/pallas_attention.py).
+  Backward recomputes the logits tile from (x, w, logz) flash-style and
+  runs two kernels — one accumulating dx over v blocks, one accumulating
+  dw over n blocks — so no O(N*V) tensor hits HBM in either direction.
+- **XLA path** (CPU tests, sharded meshes): the same math as a
+  ``lax.scan`` over vocab blocks. Saves the O(N*V) peak memory and the
+  residual; XLA still stages each block through HBM.
+
+Per-row integers/stats ride lane-broadcast [N, LANES] like the attention
+kernel's lse. Custom VJP keeps residuals to (x, w, targets, weights,
+logz) — logz is [N], everything else is an input.
+
+Parity note: the reference has no loss kernels at all (torch frameworks
+own the compute path, SURVEY.md §2.9); this is the TPU-native analogue
+of the fused-CE kernels its workloads would get from apex/liger.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _pick_bn(n: int, target: int) -> int:
+    for cand in (target, 512, 256, 128, 64, 32, 16, 8):
+        if cand <= n and n % cand == 0:
+            return cand
+    return n
+
+
+# ---------------------------------------------------------------------------
+# XLA (lax.scan) implementation — CPU fallback and sharded-mesh path
+# ---------------------------------------------------------------------------
+
+
+def _xla_forward(x, w, tgt, z_weight, block_v):
+    n, d = x.shape
+    v = w.shape[1]
+    vp = _ceil_to(v, block_v)
+    nb = vp // block_v
+    wp = jnp.pad(w, ((0, 0), (0, vp - v))).astype(x.dtype)
+
+    def body(carry, j):
+        m, l, tl = carry
+        wj = jax.lax.dynamic_slice_in_dim(wp, j * block_v, block_v, axis=1)
+        logits = jax.lax.dot_general(
+            x, wj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [n, block_v]
+        cols = j * block_v + jax.lax.iota(jnp.int32, block_v)
+        logits = jnp.where(cols[None, :] < v, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        tl = tl + jnp.sum(
+            jnp.where(cols[None, :] == tgt[:, None], logits, 0.0), axis=-1
+        )
+        return (m_new, l, tl), None
+
+    init = (
+        jnp.full((n,), NEG_INF, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, l, tl), _ = jax.lax.scan(body, init, jnp.arange(nb))
+    logz = m + jnp.log(jnp.maximum(l, 1e-30))
+    per_tok = logz - tl + z_weight * jnp.square(logz)
+    return per_tok, logz
+
+
+def _xla_backward(x, w, tgt, logz, coef_a, coef_b, block_v):
+    """coef_a/b: [n] f32 — a*softmax - b*onehot is d(loss)/d(logits)."""
+    n, d = x.shape
+    v = w.shape[1]
+    vp = _ceil_to(v, block_v)
+    nb = vp // block_v
+    wp = jnp.pad(w, ((0, 0), (0, vp - v))).astype(x.dtype)
+
+    def body(dx, j):
+        wj = jax.lax.dynamic_slice_in_dim(wp, j * block_v, block_v, axis=1)
+        logits = jax.lax.dot_general(
+            x, wj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        cols = j * block_v + jax.lax.iota(jnp.int32, block_v)
+        logits = jnp.where(cols[None, :] < v, logits, NEG_INF)
+        p = jnp.exp(logits - logz[:, None])
+        g = coef_a[:, None] * p - jnp.where(
+            cols[None, :] == tgt[:, None], coef_b[:, None], 0.0
+        )
+        g = g.astype(x.dtype)
+        dx = dx + jax.lax.dot_general(
+            g, wj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dwj = jax.lax.dot_general(
+            x, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [d, block_v]
+        return dx, dwj
+
+    dx, dws = jax.lax.scan(body, jnp.zeros((n, d), jnp.float32),
+                           jnp.arange(nb))
+    dw = dws.transpose(1, 0, 2).reshape(d, vp)[:, :v]
+    return dx, dw
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    x_ref, w_ref, tgt_ref, ptok_ref, logz_ref, m_ref, l_ref, tl_ref,
+    *, v: int, block_v: int, z_weight: float,
+):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        tl_ref[:] = jnp.zeros_like(tl_ref)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    tgt = tgt_ref[...][:, :1]                       # [bn, 1] int32
+    bn = x.shape[0]
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bn, block_v]
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (bn, block_v), 1
+    )
+    logits = jnp.where(cols < v, logits, NEG_INF)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_blk = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p_sum = jnp.sum(jnp.exp(logits - m_new), axis=-1, keepdims=True)
+    l_new = l_prev * jnp.exp(m_prev - m_new) + p_sum
+    tl_new = tl_ref[:, :1] + jnp.sum(
+        jnp.where(cols == tgt, logits, 0.0), axis=-1, keepdims=True
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    tl_ref[:] = jnp.broadcast_to(tl_new, tl_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _():
+        logz = m_new + jnp.log(jnp.maximum(l_new, 1e-30))
+        per_tok = logz - tl_new + z_weight * jnp.square(logz)
+        logz_ref[...] = jnp.broadcast_to(logz, logz_ref.shape)
+        ptok_ref[...] = jnp.broadcast_to(per_tok, ptok_ref.shape)
+
+
+def _bwd_dx_kernel(
+    x_ref, w_ref, tgt_ref, logz_ref, a_ref, b_ref, dx_ref, acc_ref,
+    *, v: int, block_v: int,
+):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    tgt = tgt_ref[...][:, :1]
+    logz = logz_ref[...][:, :1]
+    a = a_ref[...][:, :1]
+    b = b_ref[...][:, :1]
+    bn = x.shape[0]
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (bn, block_v), 1
+    )
+    logits = jnp.where(cols < v, logits, NEG_INF)
+    p = jnp.exp(logits - logz)
+    g = (a * p - jnp.where(cols == tgt, b, 0.0)).astype(x.dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        g, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nj - 1)
+    def _():
+        dx_ref[...] = acc_ref[:].astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(
+    x_ref, w_ref, tgt_ref, logz_ref, a_ref, b_ref, dw_ref, acc_ref,
+    *, v: int, block_v: int,
+):
+    i = pl.program_id(1)
+    ni = pl.num_programs(1)
+    j = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    tgt = tgt_ref[...][:, :1]
+    logz = logz_ref[...][:, :1]
+    a = a_ref[...][:, :1]
+    b = b_ref[...][:, :1]
+    bn = x.shape[0]
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (bn, block_v), 1
+    )
+    logits = jnp.where(cols < v, logits, NEG_INF)
+    p = jnp.exp(logits - logz)
+    g = (a * p - jnp.where(cols == tgt, b, 0.0)).astype(x.dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        x, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == ni - 1)
+    def _():
+        dw_ref[...] = acc_ref[:].astype(dw_ref.dtype)
+
+
+def _lane(arr, dtype):
+    """[n] -> lane-broadcast [n, LANES] (the stats layout)."""
+    return jnp.broadcast_to(arr.astype(dtype)[:, None],
+                            (arr.shape[0], LANES))
+
+
+def _pallas_forward(x, w, tgt, z_weight, block_n, block_v, interpret):
+    n, d = x.shape
+    v = w.shape[1]
+    vp = _ceil_to(v, block_v)
+    bn = _pick_bn(n, block_n)
+    wp = jnp.pad(w, ((0, 0), (0, vp - v))).astype(x.dtype)
+    grid = (n // bn, vp // block_v)
+
+    ptok, logz = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, v=v, block_v=block_v, z_weight=z_weight
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, LANES), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((bn, LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bn, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, LANES), lambda i, j: (i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bn, LANES), jnp.float32),
+            pltpu.VMEM((bn, LANES), jnp.float32),
+            pltpu.VMEM((bn, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, wp, _lane(tgt, jnp.int32))
+    return ptok[:, 0], logz[:, 0]
+
+
+def _pallas_backward(
+    x, w, tgt, logz, coef_a, coef_b, block_n, block_v, interpret
+):
+    n, d = x.shape
+    v = w.shape[1]
+    vp = _ceil_to(v, block_v)
+    bn = _pick_bn(n, block_n)
+    wp = jnp.pad(w, ((0, 0), (0, vp - v))).astype(x.dtype)
+    tgt_l = _lane(tgt, jnp.int32)
+    logz_l = _lane(logz, jnp.float32)
+    a_l = _lane(coef_a, jnp.float32)
+    b_l = _lane(coef_b, jnp.float32)
+    nv = vp // block_v
+
+    stat = pl.BlockSpec((bn, LANES), lambda i, j: (i, 0))
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, v=v, block_v=block_v),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        grid=(n // bn, nv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            stat, stat, stat, stat,
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(x, wp, tgt_l, logz_l, a_l, b_l)
+
+    # The dw kernel holds a [d, block_v] f32 accumulator on top of the
+    # streamed tiles — at d=1024, block_v=1024 that exceeds the 16 MB
+    # scoped-VMEM budget (measured on v5e), so it runs at half the vocab
+    # block. Re-pad for its own block size.
+    bv_dw = min(block_v, 512)
+    vp_dw = _ceil_to(v, bv_dw)
+    wp_dw = wp[:, :vp_dw] if vp_dw <= vp else jnp.pad(
+        w, ((0, 0), (0, vp_dw - v))
+    ).astype(x.dtype)
+    stat2 = pl.BlockSpec((bn, LANES), lambda j, i: (i, 0))
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, v=v, block_v=bv_dw),
+        out_shape=jax.ShapeDtypeStruct((d, vp_dw), jnp.float32),
+        grid=(vp_dw // bv_dw, n // bn),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((d, bv_dw), lambda j, i: (0, j)),
+            stat2, stat2, stat2, stat2,
+        ],
+        out_specs=pl.BlockSpec((d, bv_dw), lambda j, i: (0, j)),
+        scratch_shapes=[pltpu.VMEM((d, bv_dw), jnp.float32)],
+        interpret=interpret,
+    )(x, wp_dw, tgt_l, logz_l, a_l, b_l)
+    return dx, dw[:, :v]
+
+
+# ---------------------------------------------------------------------------
+# Custom-VJP core and public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_ce_core(x, w, tgt, wgt, z_weight, block_n, block_v, use_pallas):
+    per_tok, _ = (
+        _pallas_forward(x, w, tgt, z_weight, block_n, block_v,
+                        interpret=jax.default_backend() != "tpu")
+        if use_pallas
+        else _xla_forward(x, w, tgt, z_weight, block_v)
+    )
+    return jnp.sum(per_tok * wgt)
+
+
+def _core_fwd(x, w, tgt, wgt, z_weight, block_n, block_v, use_pallas):
+    if use_pallas:
+        per_tok, logz = _pallas_forward(
+            x, w, tgt, z_weight, block_n, block_v,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        per_tok, logz = _xla_forward(x, w, tgt, z_weight, block_v)
+    return jnp.sum(per_tok * wgt), (x, w, tgt, wgt, logz)
+
+
+def _core_bwd(z_weight, block_n, block_v, use_pallas, res, gbar):
+    x, w, tgt, wgt, logz = res
+    scaled = gbar * wgt                                   # [n] f32
+    coef_a = scaled * (1.0 + 2.0 * z_weight * logz)
+    coef_b = scaled
+    if use_pallas:
+        dx, dw = _pallas_backward(
+            x, w, tgt, logz, coef_a, coef_b, block_n, block_v,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        dx, dw = _xla_backward(
+            x, w, tgt, logz, coef_a, coef_b, block_v
+        )
+    return (
+        dx.astype(x.dtype),
+        dw.astype(w.dtype),
+        np.zeros(tgt.shape, jax.dtypes.float0),
+        jnp.zeros_like(wgt),
+    )
+
+
+_fused_ce_core.defvjp(_core_fwd, _core_bwd)
+
+
+def fused_cross_entropy(
+    x,
+    w,
+    targets,
+    mask=None,
+    z_weight: float = 1e-4,
+    block_n: int = 512,
+    block_v: int = 1024,
+    impl: Optional[str] = None,
+):
+    """Token-mean CE + z-loss from hidden states, no [N, V] logits.
+
+    Identical semantics to ``llama.cross_entropy(x @ w, targets, mask)``
+    (f32 logits, token-mean weighting, ``z_weight * logz^2``). x: [..., d]
+    hidden states (post final-norm, compute dtype); w: [d, V] unembedding;
+    targets int [...]; mask optional [...] — tokens with mask 0 contribute
+    nothing.
+
+    impl: "pallas" | "xla" | None (auto: pallas on TPU).
+    """
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    d = x.shape[-1]
+    n = int(np.prod(x.shape[:-1]))
+    x2 = x.reshape(n, d)
+    tgt = targets.reshape(n)
+    if mask is None:
+        wgt = jnp.full((n,), 1.0 / n, jnp.float32)
+    else:
+        m = mask.reshape(n).astype(jnp.float32)
+        wgt = m / jnp.maximum(jnp.sum(m), 1.0)
+    wgt = jax.lax.stop_gradient(wgt)
+    # Pad the token dim so any (b, s) works; padded rows carry zero weight
+    # and target 0, so they affect neither loss nor grads.
+    n_pad = _ceil_to(max(n, 8), 8)
+    if n_pad != n:
+        x2 = jnp.pad(x2, ((0, n_pad - n), (0, 0)))
+        tgt = jnp.pad(tgt, (0, n_pad - n))
+        wgt = jnp.pad(wgt, (0, n_pad - n))
+    return _fused_ce_core(
+        x2, w, tgt, wgt, z_weight, block_n, block_v, impl == "pallas"
+    )

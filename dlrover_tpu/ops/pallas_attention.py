@@ -476,11 +476,15 @@ def _fwd(q, k, v, causal, softmax_scale, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out, lse = _flash_forward(q, k, v, causal, softmax_scale, interpret)
-    return out, (q, k, v, out, lse)
+    # Residual lse is stored COMPACT [b*h, sq] — the kernel's
+    # lane-broadcast [b*h, sq, LANES] layout would pin 128x the bytes
+    # (64MB/layer at the flagship shape) across the whole backward.
+    return out, (q, k, v, out, lse[:, :, 0])
 
 
 def _bwd(causal, softmax_scale, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse2d = res
+    lse = jnp.broadcast_to(lse2d[:, :, None], lse2d.shape + (LANES,))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if os.environ.get("DLROVER_TPU_FLASH_BWD", "pallas").lower() == "xla":
@@ -513,4 +517,7 @@ def make_flash_attention(interpret: Optional[bool] = None):
             q, k, v, causal, softmax_scale, interpret
         )
 
+    # Backward residuals are O(s*d) (q/k/v/out + compact lse), so the
+    # "mlp_only" remat policy may exempt this impl from rematerialization.
+    attention_fn.saveable_residuals = True
     return attention_fn

@@ -75,3 +75,39 @@ def test_flash_in_model():
     np.testing.assert_allclose(
         np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-4
     )
+
+
+def test_mlp_only_remat_matches_dots():
+    """The mlp_only scan body (attention exempt from remat) must produce
+    the same loss and grads as the dots policy, and must silently demote
+    to dots when the attention impl doesn't declare saveable residuals."""
+    from dlrover_tpu.models import llama
+
+    flash = make_flash_attention(True)
+    assert flash.saveable_residuals
+    tokens = {"tokens": jax.random.randint(
+        jax.random.key(3), (2, 33), 0, 256
+    ).astype(jnp.int32)}
+
+    def grads(policy, attention_fn):
+        cfg = llama.tiny_config(n_layers=2, remat_policy=policy)
+        params, _ = llama.init_params(cfg, jax.random.key(0))
+        return jax.grad(
+            lambda p: llama.loss_fn(cfg, p, tokens, attention_fn)[0]
+        )(params)
+
+    g_dots = grads("dots", flash)
+    g_mlp = grads("mlp_only", flash)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        g_dots,
+        g_mlp,
+    )
+    # XLA attention has no saveable_residuals attr -> mlp_only demotes to
+    # dots rather than pinning O(s^2) residuals.
+    g_xla = grads("mlp_only", dot_product_attention)
+    assert jax.tree_util.tree_structure(g_xla) == (
+        jax.tree_util.tree_structure(g_mlp)
+    )
