@@ -67,7 +67,7 @@ def _flash_kernel(
     q_start = qi * block_q
     k_start = ki * block_k
 
-    def body():
+    def body(masked: bool):
         # Blocks are (1, bq, d) or (1, 1, bq, d) depending on the layout
         # path; normalize to 2D for the math. Matmuls keep the input
         # dtype (bf16 on TPU — full-rate MXU) and accumulate in f32;
@@ -79,7 +79,7 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
-        if causal:
+        if masked:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -92,8 +92,10 @@ def _flash_kernel(
         l_prev = l_ref[:, :1]
         m_blk = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
+        # No row is ever fully masked here: causal grids skip whole
+        # future tiles, and within a diagonal tile row r always has at
+        # least column r valid — so exp needs no -inf guard pass.
         p = jnp.exp(logits - m_new)
-        p = jnp.where(m_blk > NEG_INF / 2, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
@@ -104,10 +106,17 @@ def _flash_kernel(
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # Whole kv block in the future -> skip the tile entirely.
-        pl.when(k_start <= q_start + block_q - 1)(body)
+        # Three tile classes: fully past (no mask math — the iota/
+        # compare/where VPU passes rival the tile's MXU time at d=128),
+        # diagonal (masked), fully future (skipped).
+        q_end = q_start + block_q - 1
+        k_end = k_start + block_k - 1
+        pl.when(k_end <= q_start)(lambda: body(False))
+        pl.when((k_start <= q_end) & (k_end > q_start))(
+            lambda: body(True)
+        )
     else:
-        body()
+        body(False)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -229,7 +238,7 @@ def _bwd_dq_kernel(
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def body():
+    def body(masked: bool):
         q = q_ref[...].reshape(block_q, -1)
         k = k_ref[...].reshape(block_k, -1)
         v = v_ref[...].reshape(block_k, -1)
@@ -241,7 +250,7 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
+        if masked:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -261,9 +270,14 @@ def _bwd_dq_kernel(
         )
 
     if causal:
-        pl.when(k_start <= q_start + block_q - 1)(body)
+        # Mask math only on diagonal tiles (see _flash_kernel).
+        pl.when(k_start + block_k - 1 <= q_start)(lambda: body(False))
+        pl.when(
+            (k_start <= q_start + block_q - 1)
+            & (k_start + block_k - 1 > q_start)
+        )(lambda: body(True))
     else:
-        body()
+        body(False)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -285,7 +299,7 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def body():
+    def body(masked: bool):
         q = q_ref[...].reshape(block_q, -1)
         k = k_ref[...].reshape(block_k, -1)
         v = v_ref[...].reshape(block_k, -1)
@@ -297,7 +311,7 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if causal:
+        if masked:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -323,10 +337,15 @@ def _bwd_dkv_kernel(
         )
 
     if causal:
-        # q block entirely before the kv block contributes nothing.
-        pl.when(q_start + block_q - 1 >= k_start)(body)
+        # Mask math only on diagonal tiles; q blocks entirely before the
+        # kv block contribute nothing and are skipped.
+        pl.when(k_start + block_k - 1 <= q_start)(lambda: body(False))
+        pl.when(
+            (q_start + block_q - 1 >= k_start)
+            & (k_start + block_k - 1 > q_start)
+        )(lambda: body(True))
     else:
-        body()
+        body(False)
 
     @pl.when(j == nj - 1)
     def _():

@@ -111,3 +111,27 @@ def test_state_sharded_on_mesh():
             break
     assert mu is not None
     assert mu.sharding.shard_shape(mu.shape) == shard_shape
+
+
+def test_bf16_compute_dtype_trains():
+    """The bf16 path casts stacked layer params once outside the scan;
+    grads must still reach the caller in f32 (via the convert transpose)
+    and the loss must stay finite."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+
+    cfg = llama.tiny_config(n_layers=2, dtype="bfloat16")
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    batch = {"tokens": jax.random.randint(
+        jax.random.key(1), (2, 17), 0, cfg.vocab_size
+    ).astype(jnp.int32)}
+    (loss, _), grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(cfg, p, batch), has_aux=True
+    )(params)
+    assert np.isfinite(float(loss))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(g.dtype == jnp.float32 for g in leaves)
+    assert any(float(jnp.linalg.norm(g)) > 0 for g in leaves)
