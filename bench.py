@@ -188,6 +188,62 @@ def ce_ab_phase():
 
 
 # ---------------------------------------------------------------------------
+# Phase 1c: ring-attention inner block A/B at long local sequence lengths
+# ---------------------------------------------------------------------------
+
+
+def ring_inner_ab_phase():
+    """Per-hop inner block of ring attention at long LOCAL sequence
+    lengths (what each sp shard computes per ring hop): the old XLA
+    einsum path materializes the [h, s, s] f32 logits (8 GB at s=16k),
+    the flash path streams tiles through VMEM. Single-chip measurable —
+    the ring's ppermute hops need a real sp mesh, but the inner block is
+    where the memory/bandwidth win lives."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.ring_attention import _block_attn, _flash_block
+
+    overhead = _call_overhead()
+    b, h, d = 1, 8, 128
+    out = {}
+    for s in (4096, 8192, 16384):
+        kq, kk, kv = jax.random.split(jax.random.key(s), 3)
+        q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+        pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+        scale = d ** -0.5
+        iters = max(8, 65536 // (s // 1024) // 16)
+
+        def xla_fn(q):
+            o, m, l = _block_attn(q, k, v, pos, pos, True, scale)
+            return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+        def flash_fn(q):
+            o, lse = _flash_block(q, k, v, True, scale)
+            return o + (jnp.sum(lse) * 1e-30).astype(q.dtype)
+
+        # Guard each measurement independently: a failure at one size
+        # (e.g. XLA OOM on the materialized logits — which IS the
+        # finding) must not discard sizes already measured.
+        for name, fn in (("xla", xla_fn), ("flash", flash_fn)):
+            try:
+                t = _timed_op(fn, q, iters, overhead)
+                out[f"ring_inner_{name}_ms_s{s}"] = round(t * 1e3, 2)
+            except Exception as e:
+                out[f"ring_inner_{name}_ms_s{s}"] = None
+                out[f"ring_inner_{name}_error_s{s}"] = (
+                    f"{type(e).__name__}"[:60]
+                )
+        tx = out.get(f"ring_inner_xla_ms_s{s}")
+        tf = out.get(f"ring_inner_flash_ms_s{s}")
+        if tx and tf:
+            out[f"ring_inner_speedup_s{s}"] = round(tx / tf, 2)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Phase 2: attention A/B (pallas vs XLA) on hardware
 # ---------------------------------------------------------------------------
 
@@ -512,6 +568,10 @@ def main():
             result.update(ce_ab_phase())
         except Exception as e:  # pragma: no cover
             result["ce_ab_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            result.update(ring_inner_ab_phase())
+        except Exception as e:  # pragma: no cover
+            result["ring_inner_ab_error"] = f"{type(e).__name__}: {e}"[:200]
     goodput = goodput_phase(platform)
     goodput.update(result)
     print(json.dumps(goodput))

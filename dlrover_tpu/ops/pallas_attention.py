@@ -353,9 +353,46 @@ def _bwd_dkv_kernel(
         dv_ref[...] = dv_acc[:].astype(dv_ref.dtype).reshape(dv_ref.shape)
 
 
+def flash_backward_delta(g, out):
+    """delta_i = rowsum(dO * O), lane-broadcast to the stats layout —
+    loop-invariant for ring attention, so exposed separately."""
+    b, sq, h, _ = g.shape
+    di = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [b, sq, h]
+    return jnp.broadcast_to(
+        di.transpose(0, 2, 1).reshape(b * h, sq, 1), (b * h, sq, LANES)
+    )
+
+
 def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
-    b, sq, h, d = q.shape
-    _, skv, hkv, _ = k.shape
+    """Grad wrt (q, k, v) in the model's [b, s, h, d] layout."""
+    di = flash_backward_delta(g, out)
+    dqT, dkT, dvT = flash_backward_T(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        g.transpose(0, 2, 1, 3),
+        lse,
+        di,
+        causal,
+        softmax_scale,
+        interpret,
+    )
+    return (
+        dqT.transpose(0, 2, 1, 3),
+        dkT.transpose(0, 2, 1, 3),
+        dvT.transpose(0, 2, 1, 3),
+    )
+
+
+def flash_backward_T(qT, kT, vT, doT, lse, di, causal, softmax_scale,
+                     interpret):
+    """Backward core on PRE-TRANSPOSED [b, h, s, d] operands with a
+    precomputed delta — ring attention hoists the transposes and delta
+    out of its per-hop loop and calls this directly."""
+    b, h, sq, d = qT.shape
+    _, hkv, skv, _ = kT.shape
     groups = h // hkv
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     # 1024 blocks measure ~10% faster than 512 on v5e at d<=128 (same
@@ -369,20 +406,6 @@ def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
     block_q = _pick_block(sq, target=bwd_target)
     block_k = _pick_block(skv, target=bwd_target)
     nq = sq // block_q
-
-    # delta_i = rowsum(dO * O) — cheap XLA elementwise+reduce, then
-    # lane-broadcast to the stats layout.
-    di = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [b, sq, h]
-    di = jnp.broadcast_to(
-        di.transpose(0, 2, 1).reshape(b * h, sq, 1), (b * h, sq, LANES)
-    )
-
-    qT = q.transpose(0, 2, 1, 3)        # [b, h, sq, d]
-    kT = k.transpose(0, 2, 1, 3)        # [b, hkv, skv, d]
-    vT = v.transpose(0, 2, 1, 3)
-    doT = g.transpose(0, 2, 1, 3)
 
     q_block = (1, 1, block_q, d)
     kv_block = (1, 1, block_k, d)
@@ -403,7 +426,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qT.shape, qT.dtype),
         grid=(b * h, nq, skv // block_k),
         in_specs=[
             pl.BlockSpec(q_block, q_map),
@@ -438,8 +461,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
             block_q=block_q, block_k=block_k, nq=nq,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(kT.shape, k.dtype),
-            jax.ShapeDtypeStruct(vT.shape, v.dtype),
+            jax.ShapeDtypeStruct(kT.shape, kT.dtype),
+            jax.ShapeDtypeStruct(vT.shape, vT.dtype),
         ),
         grid=(b * hkv, skv // block_k, groups * nq),
         in_specs=[
@@ -461,11 +484,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, softmax_scale, interpret):
         interpret=interpret,
     )(qT, kT, vT, doT, lse, di)
 
-    return (
-        dq.transpose(0, 2, 1, 3),
-        dk.transpose(0, 2, 1, 3),
-        dv.transpose(0, 2, 1, 3),
-    )
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------

@@ -16,10 +16,12 @@ checkpoint/rendezvous metadata.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.ops.attention import NEG_INF
@@ -119,7 +121,244 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, rules=DEFAULT_RULES, axis_name="sp"):
+# ---------------------------------------------------------------------------
+# Pallas ring attention: the flash kernel as the per-hop inner block
+# ---------------------------------------------------------------------------
+#
+# The XLA path above materializes the full local [sq_loc, skv_loc] logits
+# tensor on every ring hop — exactly the memory/bandwidth cost flash
+# attention kills. This path instead calls the fused Pallas kernels
+# (ops/pallas_attention.py) per hop and merges the (out, lse) partials:
+#
+# - forward: out_global = sum_b exp(lse_b - lse_global) * out_b, with
+#   lse_global accumulated stably across hops;
+# - backward (ring-level custom VJP): p_ij = exp(s_ij - lse_global)
+#   globally, so each hop's (dq, dk, dv) is one flash-backward call fed
+#   the FINAL lse and the global delta = rowsum(do * out); dk/dv
+#   accumulators rotate around the ring alongside k/v and are home after
+#   n hops.
+#
+# Requires each sp shard to hold a CONTIGUOUS chunk of the sequence (the
+# layout make_ring_attention's shard_map produces): the per-hop causal
+# relation then collapses to three static cases — fully-past block (no
+# mask), diagonal block (relative causal mask), fully-future block
+# (skipped) — so the kernels never need absolute positions.
+
+
+def _flash_block(q, k, v, causal, scale):
+    """One ring hop through the Pallas forward. Returns (out [b,sq,h,d]
+    in q.dtype, lse [b, h, sq] f32)."""
+    from dlrover_tpu.ops.pallas_attention import _flash_forward
+
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, scale, interpret)
+    b, sq, h, d = q.shape
+    return out, lse[:, :, 0].reshape(b, h, sq)
+
+
+def _merge(o, lse, out_b, lse_b):
+    """Merge a block partial into the running (o f32 [b,sq,h,d],
+    lse f32 [b,h,sq]) accumulator."""
+    m = jnp.maximum(lse, lse_b)
+    lse_new = m + jnp.log(jnp.exp(lse - m) + jnp.exp(lse_b - m))
+    w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w_new = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+    o = o * w_old + out_b.astype(jnp.float32) * w_new
+    return o, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def ring_flash_attention_local(
+    q, k, v, q_positions, kv_positions,
+    axis_name: str = "sp",
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+):
+    out, _ = _ring_flash_fwd(
+        q, k, v, q_positions, kv_positions, axis_name, causal,
+        softmax_scale,
+    )
+    return out
+
+
+def _contiguity_poison(q_pos, kv_pos):
+    """NaN unless positions are what the pallas path assumes: every batch
+    row identical and contiguous within the shard (the layout
+    make_ring_attention's shard_map produces from global iota positions).
+    Packed/per-batch positions then fail LOUDLY (NaN loss on step one)
+    instead of training on silently wrong causal masks — such callers
+    must use impl="xla"."""
+    sq = q_pos.shape[1]
+    skv = kv_pos.shape[1]
+    ok_q = jnp.all(
+        q_pos == q_pos[0, 0] + jnp.arange(sq, dtype=q_pos.dtype)[None, :]
+    )
+    ok_kv = jnp.all(
+        kv_pos
+        == kv_pos[0, 0] + jnp.arange(skv, dtype=kv_pos.dtype)[None, :]
+    )
+    return jnp.where(ok_q & ok_kv, 0.0, jnp.nan).astype(jnp.float32)
+
+
+def _ring_flash_fwd(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
+    b, sq, h, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    scale = scale if scale is not None else d ** -0.5
+    q_off = q_pos[0, 0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def skip():
+        return (
+            jnp.zeros((b, sq, h, d), q.dtype),
+            jnp.full((b, h, sq), NEG_INF, jnp.float32),
+        )
+
+    def hop(i, carry):
+        o, lse, k_cur, v_cur, kvp = carry
+        kv_off = kvp[0, 0]
+        if causal:
+            out_b, lse_b = jax.lax.cond(
+                kv_off > q_off,
+                skip,
+                lambda: jax.lax.cond(
+                    kv_off == q_off,
+                    lambda: _flash_block(q, k_cur, v_cur, True, scale),
+                    lambda: _flash_block(q, k_cur, v_cur, False, scale),
+                ),
+            )
+        else:
+            out_b, lse_b = _flash_block(q, k_cur, v_cur, False, scale)
+        o, lse = _merge(o, lse, out_b, lse_b)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        kvp = jax.lax.ppermute(kvp, axis_name, perm)
+        return (o, lse, k_cur, v_cur, kvp)
+
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    o, lse, _, _, _ = jax.lax.fori_loop(
+        0, n, hop, (o0, lse0, k, v, kv_pos)
+    )
+    o = o + _contiguity_poison(q_pos, kv_pos)
+    return o.astype(q.dtype), lse
+
+
+def _ring_fwd_rule(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd(
+        q, k, v, q_pos, kv_pos, axis_name, causal, scale
+    )
+    return out, (q, k, v, q_pos, kv_pos, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, scale, res, g):
+    from dlrover_tpu.ops.pallas_attention import (
+        LANES,
+        flash_backward_T,
+        flash_backward_delta,
+    )
+
+    q, k, v, q_pos, kv_pos, out, lse = res
+    b, sq, h, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    scale_v = scale if scale is not None else d ** -0.5
+    interpret = jax.default_backend() != "tpu"
+    q_off = q_pos[0, 0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # Loop invariants, hoisted: final lse + global delta (from the FINAL
+    # out/do — with p_ij = exp(s_ij - lse_final), each hop's grads are
+    # exact partials of the global softmax), and the [b, h, s, d]
+    # transposes the backward kernels want. k/v rotate around the ring
+    # already transposed so no per-hop transpose remains.
+    lse_lane = jnp.broadcast_to(
+        lse.reshape(b * h, sq)[:, :, None], (b * h, sq, LANES)
+    )
+    di = flash_backward_delta(g, out)
+    qT = q.transpose(0, 2, 1, 3)
+    doT = g.transpose(0, 2, 1, 3)
+    kT0 = k.transpose(0, 2, 1, 3)
+    vT0 = v.transpose(0, 2, 1, 3)
+
+    def skip(kT_cur, vT_cur):
+        return (
+            jnp.zeros_like(qT),
+            jnp.zeros_like(kT_cur),
+            jnp.zeros_like(vT_cur),
+        )
+
+    def hop(i, carry):
+        dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp = carry
+        kv_off = kvp[0, 0]
+
+        def run(causal_blk):
+            return lambda: flash_backward_T(
+                qT, kT_cur, vT_cur, doT, lse_lane, di, causal_blk,
+                scale_v, interpret,
+            )
+
+        if causal:
+            dqb, dkb, dvb = jax.lax.cond(
+                kv_off > q_off,
+                lambda: skip(kT_cur, vT_cur),
+                lambda: jax.lax.cond(
+                    kv_off == q_off, run(True), run(False)
+                ),
+            )
+        else:
+            dqb, dkb, dvb = run(False)()
+        dqT = dqT + dqb.astype(jnp.float32)
+        dkT_acc = dkT_acc + dkb.astype(jnp.float32)
+        dvT_acc = dvT_acc + dvb.astype(jnp.float32)
+        # dk/dv accumulators ride the ring WITH k/v: after n hops each
+        # shard's accumulated gradient is back on the shard that owns it.
+        kT_cur = jax.lax.ppermute(kT_cur, axis_name, perm)
+        vT_cur = jax.lax.ppermute(vT_cur, axis_name, perm)
+        kvp = jax.lax.ppermute(kvp, axis_name, perm)
+        dkT_acc = jax.lax.ppermute(dkT_acc, axis_name, perm)
+        dvT_acc = jax.lax.ppermute(dvT_acc, axis_name, perm)
+        return (dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp)
+
+    dq0 = jnp.zeros(qT.shape, jnp.float32)
+    dk0 = jnp.zeros(kT0.shape, jnp.float32)
+    dv0 = jnp.zeros(vT0.shape, jnp.float32)
+    dqT, dkT, dvT, _, _, _ = jax.lax.fori_loop(
+        0, n, hop, (dq0, dk0, dv0, kT0, vT0, kv_pos)
+    )
+    return (
+        dqT.transpose(0, 2, 1, 3).astype(q.dtype),
+        dkT.transpose(0, 2, 1, 3).astype(k.dtype),
+        dvT.transpose(0, 2, 1, 3).astype(v.dtype),
+        np.zeros(q_pos.shape, jax.dtypes.float0),
+        np.zeros(kv_pos.shape, jax.dtypes.float0),
+    )
+
+
+ring_flash_attention_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+def _ring_impl(impl: Optional[str]) -> str:
+    """pallas (flash inner block) on TPU, xla elsewhere; DLROVER_TPU_RING
+    overrides. The pallas path assumes each sp shard holds a contiguous
+    chunk of the sequence — callers with packed/arbitrary positions must
+    pass impl="xla"."""
+    if impl is None:
+        impl = os.environ.get("DLROVER_TPU_RING", "auto")
+    impl = impl.lower()
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(
+            f"ring attention impl {impl!r} not in ('auto', 'pallas', "
+            f"'xla') — refusing to silently fall back"
+        )
+    return impl
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    rules=DEFAULT_RULES,
+    axis_name="sp",
+    impl: Optional[str] = None,
+):
     """Returns an ``attention_fn`` drop-in for ``dot_product_attention``
     that runs ring attention along ``axis_name`` via a shard_map island.
     Plug into ``llama.forward(..., attention_fn=...)``.
@@ -127,6 +366,12 @@ def make_ring_attention(mesh: Mesh, rules=DEFAULT_RULES, axis_name="sp"):
     q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), rules)
     kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), rules)
     pos_spec = logical_to_spec(("batch", "seq"), rules)
+    impl = _ring_impl(impl)
+    local_fn = (
+        ring_flash_attention_local
+        if impl == "pallas"
+        else ring_attention_local
+    )
 
     def attention_fn(
         q, k, v, causal=True, q_positions=None, kv_positions=None,
@@ -141,12 +386,13 @@ def make_ring_attention(mesh: Mesh, rules=DEFAULT_RULES, axis_name="sp"):
         q_positions = jnp.broadcast_to(q_positions, (b, sq))
         kv_positions = jnp.broadcast_to(kv_positions, (b, skv))
 
-        body = functools.partial(
-            ring_attention_local,
-            axis_name=axis_name,
-            causal=causal,
-            softmax_scale=softmax_scale,
-        )
+        # Positional call: custom_vjp functions reject keyword args for
+        # nondiff parameters.
+        def body(q, k, v, qp, kp):
+            return local_fn(
+                q, k, v, qp, kp, axis_name, causal, softmax_scale
+            )
+
         return jax.shard_map(
             body,
             mesh=mesh,
@@ -155,4 +401,7 @@ def make_ring_attention(mesh: Mesh, rules=DEFAULT_RULES, axis_name="sp"):
             check_vma=False,
         )(q, k, v, q_positions, kv_positions)
 
+    # The pallas path's ring-level custom VJP keeps O(s*d) residuals
+    # (q/k/v/out + lse), so mlp_only remat may exempt it (llama.py).
+    attention_fn.saveable_residuals = impl == "pallas"
     return attention_fn
