@@ -239,7 +239,10 @@ def _ring_flash_fwd(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
     o, lse, _, _, _ = jax.lax.fori_loop(
         0, n, hop, (o0, lse0, k, v, kv_pos)
     )
-    o = o + _contiguity_poison(q_pos, kv_pos)
+    if causal:
+        # Only causal masking consults positions; bidirectional ring
+        # attention is position-free and needs no guard.
+        o = o + _contiguity_poison(q_pos, kv_pos)
     return o.astype(q.dtype), lse
 
 

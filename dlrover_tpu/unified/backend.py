@@ -38,6 +38,9 @@ class UnifiedEnv:
     BUNDLE_ID = "DLROVER_TPU_BUNDLE_ID"
     NODE_SLOT = "DLROVER_TPU_NODE_SLOT"
     JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    # Which backend launched this worker — the runtime data plane
+    # (unified/rpc.py) picks its registry implementation from it.
+    BACKEND = "DLROVER_TPU_UNIFIED_BACKEND"
 
 
 @dataclass
@@ -75,7 +78,9 @@ def worker_cmd(role: RoleConfig) -> list:
     return cmd + role.args
 
 
-def worker_envs(vertex: Vertex, job_name: str) -> Dict[str, str]:
+def worker_envs(
+    vertex: Vertex, job_name: str, backend: str = "local"
+) -> Dict[str, str]:
     return {
         UnifiedEnv.ROLE: vertex.role,
         UnifiedEnv.ROLE_RANK: str(vertex.rank),
@@ -84,6 +89,7 @@ def worker_envs(vertex: Vertex, job_name: str) -> Dict[str, str]:
         UnifiedEnv.BUNDLE_ID: str(vertex.bundle_id),
         UnifiedEnv.NODE_SLOT: str(vertex.node_slot),
         UnifiedEnv.JOB_NAME: job_name,
+        UnifiedEnv.BACKEND: backend,
     }
 
 
@@ -129,7 +135,7 @@ class LocalProcessBackend(Backend):
     ) -> WorkerHandle:
         env = dict(os.environ)
         env.update(vertex.envs)
-        env.update(worker_envs(vertex, job_name))
+        env.update(worker_envs(vertex, job_name, backend="local"))
         rc_path = self._rc_path(vertex, job_name)
         try:
             os.unlink(rc_path)
@@ -367,7 +373,7 @@ class RayBackend(Backend):
         ray = self._ray
         name = self._actor_name(vertex, job_name)
         env = dict(vertex.envs)
-        env.update(worker_envs(vertex, job_name))
+        env.update(worker_envs(vertex, job_name, backend="ray"))
         options = {
             "name": name,
             "lifetime": "detached",

@@ -112,6 +112,18 @@ class PrimeManager:
         one crashes mid-start."""
         if self.stage != JobStage.INIT:
             return
+        # Publish the role -> world-size manifest so the in-worker data
+        # plane's rpc_all (unified/rpc.py) can fan out before every
+        # worker has registered.
+        from dlrover_tpu.unified.backend import RayBackend
+        from dlrover_tpu.unified.rpc import write_manifest
+
+        write_manifest(
+            self.config.job_name,
+            {r.name: r.total for r in self.config.roles},
+            backend="ray" if isinstance(self.backend, RayBackend)
+            else "local",
+        )
         self.stage = JobStage.READY
 
     def start(self):
@@ -128,6 +140,22 @@ class PrimeManager:
         self.prepare()
         prev = self._restored_state
         resuming = prev.get("stage") == JobStage.RUNNING
+        if not resuming:
+            # Fresh start: drop stale data-plane registrations from any
+            # previous run of this job name (live ones survive a
+            # self-failover resume untouched).
+            try:
+                from dlrover_tpu.unified.backend import RayBackend
+                from dlrover_tpu.unified.rpc import create_registry
+
+                create_registry(
+                    self.config.job_name,
+                    backend="ray"
+                    if isinstance(self.backend, RayBackend)
+                    else "local",
+                ).clear()
+            except Exception:  # noqa: BLE001 - best-effort hygiene
+                pass
         with self._lock:
             for name, sm in self.submasters.items():
                 sm.restarts = prev.get("role_restarts", {}).get(name, 0)

@@ -1,0 +1,583 @@
+"""In-worker runtime data plane for unified jobs: actor RPC + queues.
+
+Parity: reference dlrover/python/unified/api/runtime/rpc_helper.py
+(export_rpc_method / rpc_call), api/runtime/queue.py (named data queues
+shipping rollouts between collocated roles), and util/actor_helper.py
+(batch invocation over a role). The reference rides Ray actor handles;
+here the transport is a tiny length-prefixed-pickle TCP endpoint every
+worker can open, so the SAME API works on both backends:
+
+- **endpoint**: each worker process lazily starts one threaded TCP
+  server (port 0). RPC methods exported with :func:`export_rpc` and
+  queues created with :func:`create_queue` live on it.
+- **registry**: maps (role, rank) -> "host:port" and queue name ->
+  owner address. Local backend: atomic JSON files in a job-derived
+  runtime dir (same-host processes). Ray backend: a named detached
+  registry actor (cluster-wide).
+- **client**: :func:`rpc` (role/rank-addressed request/reply),
+  :func:`rpc_all` (fan-out to every rank of a role, gathered with a
+  thread pool — the actor_helper batch analogue), :func:`get_queue`
+  (put/get against the owning worker's endpoint).
+
+Payloads are pickled — numpy arrays (and anything picklable) ship
+as-is; device arrays should be pulled to host first (np.asarray).
+"""
+
+import io
+import json
+import os
+import pickle
+import queue as queue_mod
+import socket
+import socketserver
+import tempfile
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+_MAX_MSG = 1 << 31
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol: 8-byte big-endian length + pickle
+# ---------------------------------------------------------------------------
+
+
+def _send(sock: socket.socket, obj: Any):
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = buf.getvalue()
+    sock.sendall(len(data).to_bytes(8, "big") + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    size = int.from_bytes(hdr, "big")
+    if size > _MAX_MSG:
+        raise ValueError(f"message too large: {size}")
+    parts, got = [], 0
+    while got < size:
+        chunk = sock.recv(min(1 << 20, size - got))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        parts.append(chunk)
+        got += len(chunk)
+    return pickle.loads(b"".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# Worker endpoint (server side)
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        endpoint: "WorkerEndpoint" = self.server.endpoint  # type: ignore
+        endpoint.track(self.request)
+        try:
+            while True:
+                req = _recv(self.request)
+                _send(self.request, endpoint.dispatch(req))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            endpoint.untrack(self.request)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class WorkerEndpoint:
+    """One per worker process: serves exported RPC methods and owned
+    queues over TCP."""
+
+    def __init__(self, host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None):
+        """``host`` is the bind address; ``advertise_host`` (default:
+        host) is what goes into the registry — bind 0.0.0.0 and
+        advertise the node IP for cross-node (Ray) jobs."""
+        self._methods: Dict[str, Callable] = {}
+        self._queues: Dict[str, queue_mod.Queue] = {}
+        self._lock = threading.Lock()
+        self._live_conns: set = set()
+        self._server = _Server((host, 0), _Handler)
+        self._server.endpoint = self  # type: ignore
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dlrover-tpu-worker-endpoint",
+        )
+        self._thread.start()
+        port = self._server.server_address[1]
+        self.addr = f"{advertise_host or host}:{port}"
+
+    def export(self, name: str, fn: Callable):
+        with self._lock:
+            self._methods[name] = fn
+
+    def create_queue(self, name: str, maxsize: int = 0):
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = queue_mod.Queue(maxsize=maxsize)
+            return self._queues[name]
+
+    def dispatch(self, req: dict) -> dict:
+        try:
+            kind = req.get("kind")
+            if kind == "rpc":
+                fn = self._methods.get(req["method"])
+                if fn is None:
+                    return {
+                        "ok": False,
+                        "error": f"no rpc method {req['method']!r}; "
+                        f"exported: {sorted(self._methods)}",
+                    }
+                value = fn(*req.get("args", ()), **req.get("kwargs", {}))
+                return {"ok": True, "value": value}
+            if kind == "qput":
+                q = self._queues.get(req["queue"])
+                if q is None:
+                    return {"ok": False, "error": "no such queue"}
+                try:
+                    q.put(req["item"], timeout=req.get("timeout"))
+                    return {"ok": True}
+                except queue_mod.Full:
+                    return {"ok": False, "error": "queue full"}
+            if kind == "qget":
+                q = self._queues.get(req["queue"])
+                if q is None:
+                    return {"ok": False, "error": "no such queue"}
+                try:
+                    item = q.get(timeout=req.get("timeout"))
+                    return {"ok": True, "value": item}
+                except queue_mod.Empty:
+                    return {"ok": False, "error": "queue empty"}
+            if kind == "qsize":
+                q = self._queues.get(req["queue"])
+                if q is None:
+                    return {"ok": False, "error": "no such queue"}
+                return {"ok": True, "value": q.qsize()}
+            return {"ok": False, "error": f"unknown kind {kind!r}"}
+        except Exception as e:  # noqa: BLE001 - serve the error to caller
+            return {
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc(),
+            }
+
+    def track(self, sock: socket.socket):
+        with self._lock:
+            self._live_conns.add(sock)
+
+    def untrack(self, sock: socket.socket):
+        with self._lock:
+            self._live_conns.discard(sock)
+
+    def close(self):
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        # Sever live connections too — handler threads otherwise keep
+        # answering on them after shutdown(), which would make a stale
+        # client think a restarted worker never moved.
+        with self._lock:
+            conns = list(self._live_conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def runtime_dir(job_name: str) -> str:
+    """Job-derived registry dir — manager and workers compute the same
+    path with no plumbing. Override with DLROVER_TPU_RUNTIME_DIR."""
+    env = os.getenv("DLROVER_TPU_RUNTIME_DIR")
+    if env:
+        return env
+    return os.path.join(
+        tempfile.gettempdir(), f"dlrover_tpu_rt_{job_name}"
+    )
+
+
+class FileRegistry:
+    """Atomic-JSON-file registry for same-host (local backend) jobs."""
+
+    def __init__(self, job_name: str):
+        self.dir = runtime_dir(job_name)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _write(self, key: str, value: dict):
+        path = os.path.join(self.dir, key + ".json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, path)
+
+    def _read(self, key: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, key + ".json")) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def register_worker(self, role: str, rank: int, addr: str):
+        self._write(f"w.{role}.{rank}", {"addr": addr})
+
+    def lookup_worker(self, role: str, rank: int) -> Optional[str]:
+        rec = self._read(f"w.{role}.{rank}")
+        return rec["addr"] if rec else None
+
+    def register_queue(self, name: str, addr: str):
+        self._write(f"q.{name}", {"addr": addr})
+
+    def lookup_queue(self, name: str) -> Optional[str]:
+        rec = self._read(f"q.{name}")
+        return rec["addr"] if rec else None
+
+    def set_manifest(self, roles: Dict[str, int]):
+        self._write("manifest", roles)
+
+    def manifest(self) -> Dict[str, int]:
+        return self._read("manifest") or {}
+
+    def clear(self):
+        """Drop stale worker/queue registrations (a previous run of the
+        same job name). The manager calls this on a fresh start — never
+        on a self-failover resume, whose workers are live and
+        registered."""
+        for name in os.listdir(self.dir):
+            if name.startswith(("w.", "q.")) and name.endswith(".json"):
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+
+class RayRegistry:
+    """Named detached Ray actor holding the same mappings — cluster-wide
+    for the Ray backend (workers may sit on different nodes)."""
+
+    ACTOR_FMT = "{job}-dlrover-tpu-runtime-registry"
+
+    def __init__(self, job_name: str):
+        import ray
+
+        self._ray = ray
+        name = self.ACTOR_FMT.format(job=job_name)
+
+        @ray.remote
+        class _Reg:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+
+            def get(self, k):
+                return self.d.get(k)
+
+            def clear(self):
+                self.d = {
+                    k: v for k, v in self.d.items()
+                    if not k.startswith(("w.", "q."))
+                }
+
+        try:
+            self._actor = ray.get_actor(name)
+        except ValueError:
+            self._actor = _Reg.options(
+                name=name, lifetime="detached"
+            ).remote()
+
+    def _put(self, k, v):
+        self._ray.get(self._actor.put.remote(k, v))
+
+    def _get(self, k):
+        return self._ray.get(self._actor.get.remote(k))
+
+    def register_worker(self, role, rank, addr):
+        self._put(f"w.{role}.{rank}", addr)
+
+    def lookup_worker(self, role, rank):
+        return self._get(f"w.{role}.{rank}")
+
+    def register_queue(self, name, addr):
+        self._put(f"q.{name}", addr)
+
+    def lookup_queue(self, name):
+        return self._get(f"q.{name}")
+
+    def set_manifest(self, roles):
+        self._put("manifest", roles)
+
+    def manifest(self):
+        return self._get("manifest") or {}
+
+    def clear(self):
+        self._ray.get(self._actor.clear.remote())
+
+
+def create_registry(job_name: str, backend: Optional[str] = None):
+    backend = backend or os.getenv("DLROVER_TPU_UNIFIED_BACKEND", "local")
+    if backend == "ray":
+        return RayRegistry(job_name)
+    return FileRegistry(job_name)
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+
+class _Conn:
+    """One persistent connection with a lock (requests are serialized
+    per target — parallelism comes from rpc_all's thread pool opening
+    distinct connections)."""
+
+    def __init__(self, addr: str, timeout: float):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=timeout
+        )
+        self._lock = threading.Lock()
+
+    def call(self, req: dict, timeout: Optional[float]) -> dict:
+        with self._lock:
+            self._sock.settimeout(timeout)
+            _send(self._sock, req)
+            return _recv(self._sock)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _wait_lookup(fn, what: str, timeout: float):
+    deadline = time.time() + timeout
+    while True:
+        got = fn()
+        if got:
+            return got
+        if time.time() > deadline:
+            raise TimeoutError(f"{what} not registered after {timeout}s")
+        time.sleep(0.05)
+
+
+class QueueHandle:
+    """Named queue living on its creator's endpoint."""
+
+    def __init__(self, name: str, registry, resolve_timeout: float = 60.0):
+        self.name = name
+        self._registry = registry
+        self._resolve_timeout = resolve_timeout
+        self._conn: Optional[_Conn] = None
+
+    def _ensure(self) -> _Conn:
+        if self._conn is None:
+            try:
+                addr = _wait_lookup(
+                    lambda: self._registry.lookup_queue(self.name),
+                    f"queue {self.name!r}",
+                    self._resolve_timeout,
+                )
+            except TimeoutError as e:
+                # Registration timeout, not a request timeout — must not
+                # be caught by the callers' no-resend TimeoutError path.
+                raise RpcError(str(e)) from None
+            self._conn = _Conn(addr, self._resolve_timeout)
+        return self._conn
+
+    def _call(self, req: dict, timeout: Optional[float]) -> dict:
+        # Dead peer -> reconnect within resolve_timeout (the owner may
+        # be mid-gang-restart; its new address appears in the registry
+        # when it re-registers). A socket TIMEOUT is different: the
+        # request may still execute server-side, so re-sending could
+        # double-apply it — raise instead.
+        deadline = time.time() + self._resolve_timeout
+        while True:
+            try:
+                return self._ensure().call(req, timeout)
+            except TimeoutError:
+                self.close()
+                raise RpcError(
+                    f"queue {self.name!r} request timed out "
+                    f"(NOT retried: the peer may have executed it)"
+                ) from None
+            except (ConnectionError, OSError) as e:
+                self.close()
+                if time.time() > deadline:
+                    raise RpcError(
+                        f"queue {self.name!r} owner unreachable: {e}"
+                    ) from e
+                time.sleep(0.1)
+
+    def put(self, item, timeout: Optional[float] = 60.0):
+        rsp = self._call(
+            {"kind": "qput", "queue": self.name, "item": item,
+             "timeout": timeout},
+            None if timeout is None else timeout + 5.0,
+        )
+        if not rsp.get("ok"):
+            raise RpcError(rsp.get("error"))
+
+    def get(self, timeout: Optional[float] = 60.0):
+        rsp = self._call(
+            {"kind": "qget", "queue": self.name, "timeout": timeout},
+            None if timeout is None else timeout + 5.0,
+        )
+        if not rsp.get("ok"):
+            raise RpcError(rsp.get("error"))
+        return rsp["value"]
+
+    def qsize(self) -> int:
+        rsp = self._call({"kind": "qsize", "queue": self.name}, 10.0)
+        if not rsp.get("ok"):
+            raise RpcError(rsp.get("error"))
+        return rsp["value"]
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+class RuntimeClient:
+    """role/rank-addressed RPC + queue access. Workers normally use the
+    module-level helpers in unified.runtime; tests and the manager can
+    construct one directly for any job."""
+
+    def __init__(self, job_name: str, backend: Optional[str] = None,
+                 resolve_timeout: float = 60.0):
+        self.job_name = job_name
+        self.registry = create_registry(job_name, backend)
+        self._resolve_timeout = resolve_timeout
+        self._conns: Dict[str, _Conn] = {}
+        self._lock = threading.Lock()
+
+    def _conn_for(self, role: str, rank: int) -> _Conn:
+        key = f"{role}.{rank}"
+        with self._lock:
+            conn = self._conns.get(key)
+        if conn is not None:
+            return conn
+        try:
+            addr = _wait_lookup(
+                lambda: self.registry.lookup_worker(role, rank),
+                f"worker {role}[{rank}]",
+                self._resolve_timeout,
+            )
+        except TimeoutError as e:
+            # Registration timeout, not a request timeout — keep it out
+            # of the callers' no-resend TimeoutError path.
+            raise RpcError(str(e)) from None
+        conn = _Conn(addr, self._resolve_timeout)
+        with self._lock:
+            self._conns[key] = conn
+        return conn
+
+    def _drop_conn(self, role: str, rank: int):
+        key = f"{role}.{rank}"
+        with self._lock:
+            conn = self._conns.pop(key, None)
+        if conn is not None:
+            conn.close()
+
+    def rpc(self, role: str, method: str, *args,
+            rank: int = 0, timeout: float = 60.0, **kwargs):
+        """Request/reply against one worker's exported method.
+
+        Transport semantics: a DEAD connection retries against the
+        registry until ``resolve_timeout`` (the target may be mid-
+        restart and re-register at a new address); a socket TIMEOUT
+        raises immediately and is never re-sent — the peer may have
+        executed the (possibly non-idempotent) method already.
+        """
+        req = {"kind": "rpc", "method": method, "args": args,
+               "kwargs": kwargs}
+        deadline = time.time() + self._resolve_timeout
+        while True:
+            try:
+                rsp = self._conn_for(role, rank).call(req, timeout)
+                break
+            except TimeoutError:
+                self._drop_conn(role, rank)
+                raise RpcError(
+                    f"rpc {role}[{rank}].{method} timed out after "
+                    f"{timeout}s (NOT retried: the peer may have "
+                    f"executed it)"
+                ) from None
+            except (ConnectionError, OSError) as e:
+                self._drop_conn(role, rank)
+                if time.time() > deadline:
+                    raise RpcError(
+                        f"rpc {role}[{rank}] unreachable: {e}"
+                    ) from e
+                time.sleep(0.1)
+        if not rsp.get("ok"):
+            raise RpcError(
+                f"rpc {role}[{rank}].{method}: {rsp.get('error')}"
+            )
+        return rsp["value"]
+
+    def rpc_all(self, role: str, method: str, *args,
+                timeout: float = 60.0, **kwargs) -> List[Any]:
+        """Fan out to every rank of ``role`` (actor_helper batch call);
+        returns results in rank order, raising if any rank failed."""
+        world = self.registry.manifest().get(role)
+        if world is None:
+            raise RpcError(
+                f"role {role!r} not in manifest "
+                f"{self.registry.manifest()} — is the job running?"
+            )
+        with ThreadPoolExecutor(max_workers=min(world, 32)) as pool:
+            futs = [
+                pool.submit(
+                    self.rpc, role, method, *args,
+                    rank=r, timeout=timeout, **kwargs,
+                )
+                for r in range(world)
+            ]
+            return [f.result() for f in futs]
+
+    def queue(self, name: str) -> QueueHandle:
+        return QueueHandle(name, self.registry, self._resolve_timeout)
+
+    def close(self):
+        with self._lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+
+
+def write_manifest(job_name: str, roles: Dict[str, int],
+                   backend: Optional[str] = None):
+    """Called by the manager before workers start so rpc_all knows each
+    role's world size."""
+    try:
+        create_registry(job_name, backend).set_manifest(roles)
+    except Exception as e:  # noqa: BLE001 - data plane must not kill jobs
+        logger.warning("runtime manifest not written: %s", e)

@@ -1,12 +1,30 @@
-"""In-worker runtime helpers for unified jobs.
+"""In-worker runtime API for unified jobs.
 
-Parity: reference dlrover/python/unified/api/runtime
-(current_worker() etc.) — a worker launched by the unified backend reads
-its role coordinates from the injected env.
+Parity: reference dlrover/python/unified/api/runtime — current_worker()
+coordinates, rpc_helper (export_rpc / rpc / rpc_all) and data queues
+(create_queue / get_queue) so collocated roles (e.g. rollout -> reward
+-> actor in an RL job) exchange real tensors through a sanctioned
+channel instead of the filesystem. Transport + registry live in
+unified/rpc.py and work on both the local-process and Ray backends.
+
+Usage, in worker code::
+
+    from dlrover_tpu.unified import runtime
+
+    me = runtime.current_worker()
+    runtime.export_rpc("update_weights", lambda w: apply(w))
+    q = runtime.create_queue("rollouts")        # owner side
+    ...
+    q = runtime.get_queue("rollouts")           # consumer side
+    batch = q.get()
+    runtime.rpc("actor", "update_weights", weights, rank=0)
+    losses = runtime.rpc_all("actor", "train_step", batch)
 """
 
 import os
+import threading
 from dataclasses import dataclass
+from typing import Optional
 
 from dlrover_tpu.unified.backend import UnifiedEnv
 
@@ -36,3 +54,117 @@ def current_worker() -> WorkerInfo:
         group_index=int(os.getenv(UnifiedEnv.GROUP_INDEX, "0")),
         bundle_id=int(os.getenv(UnifiedEnv.BUNDLE_ID, "-1")),
     )
+
+
+# ---------------------------------------------------------------------------
+# Process-level data plane (lazy: nothing binds until first use)
+# ---------------------------------------------------------------------------
+
+_state_lock = threading.Lock()
+_endpoint = None
+_client = None
+
+
+def _ensure_endpoint():
+    """Start this worker's TCP endpoint and register it (role, rank) in
+    the job registry on first use."""
+    global _endpoint
+    with _state_lock:
+        if _endpoint is None:
+            from dlrover_tpu.unified.rpc import (
+                WorkerEndpoint,
+                create_registry,
+            )
+
+            info = current_worker()
+            host = os.getenv("DLROVER_TPU_RUNTIME_HOST")
+            advertise = None
+            if host is None:
+                if os.getenv(UnifiedEnv.BACKEND) == "ray":
+                    # Cross-node job: bind everywhere, advertise this
+                    # node's routable IP in the cluster-wide registry.
+                    host = "0.0.0.0"
+                    advertise = _node_ip()
+                else:
+                    host = "127.0.0.1"
+            _endpoint = WorkerEndpoint(host=host, advertise_host=advertise)
+            create_registry(info.job_name).register_worker(
+                info.role, info.rank, _endpoint.addr
+            )
+        return _endpoint
+
+
+def _node_ip() -> str:
+    try:
+        import ray
+
+        return ray.util.get_node_ip_address()
+    except Exception:  # noqa: BLE001 - fall back to hostname routing
+        import socket
+
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _ensure_client():
+    global _client
+    with _state_lock:
+        if _client is None:
+            from dlrover_tpu.unified.rpc import RuntimeClient
+
+            _client = RuntimeClient(current_worker().job_name)
+        return _client
+
+
+def export_rpc(name: str, fn):
+    """Expose ``fn`` to other workers as request/reply method ``name``
+    (reference rpc_helper.export_rpc_method)."""
+    _ensure_endpoint().export(name, fn)
+
+
+def rpc(role: str, method: str, *args, rank: int = 0,
+        timeout: float = 60.0, **kwargs):
+    """Call ``method`` on worker (role, rank); returns its result or
+    raises RpcError (reference rpc_helper.rpc_call)."""
+    return _ensure_client().rpc(
+        role, method, *args, rank=rank, timeout=timeout, **kwargs
+    )
+
+
+def rpc_all(role: str, method: str, *args, timeout: float = 60.0,
+            **kwargs):
+    """Call ``method`` on EVERY rank of ``role``; results in rank order
+    (reference util/actor_helper batch invocation)."""
+    return _ensure_client().rpc_all(
+        role, method, *args, timeout=timeout, **kwargs
+    )
+
+
+def create_queue(name: str, maxsize: int = 0):
+    """Create (and own) named queue ``name`` on this worker, register
+    it job-wide, and return a handle to it."""
+    ep = _ensure_endpoint()
+    ep.create_queue(name, maxsize=maxsize)
+    info = current_worker()
+    from dlrover_tpu.unified.rpc import create_registry
+
+    create_registry(info.job_name).register_queue(name, ep.addr)
+    return get_queue(name)
+
+
+def get_queue(name: str):
+    """Handle to a queue another worker created (blocks briefly until
+    the owner registers it)."""
+    return _ensure_client().queue(name)
+
+
+def reset(close: bool = True):
+    """Tear down this process's endpoint/client (tests; forked
+    workers)."""
+    global _endpoint, _client
+    with _state_lock:
+        if close and _endpoint is not None:
+            _endpoint.close()
+        if close and _client is not None:
+            _client.close()
+        _endpoint = None
+        _client = None

@@ -1,24 +1,27 @@
-"""Multi-role (RL-style) job on the unified layer.
+"""Multi-role (RL-style) job on the unified layer, with roles exchanging
+REAL tensors through the in-worker runtime API.
 
 Run:
 
     python examples/unified_rl.py
 
-What this demonstrates:
-- the DLJobBuilder RL sugar (actor/rollout/reward roles);
+The data plane (dlrover_tpu.unified.runtime — parity with the
+reference's unified/api/runtime rpc_helper + queues):
+
+    rollout (2 procs) --numpy batches--> [queue "rollouts"]
+        --> reward (1 proc, scores each batch) --> [queue "scored"]
+        --> actor rank 0 ("trains": weight update per batch), then
+            broadcasts weights to ALL actors via rpc_all("set_weights")
+
+Also demonstrated:
+- DLJobBuilder RL sugar (actor/rollout/reward roles);
 - collocation: actor + rollout packed onto the same node slot
   (STRICT_PACK bundles; on Ray each slot becomes a placement group);
-- per-role SubMasters supervising their workers with gang restart —
-  the rollout role is marked elastic, so losing one member re-forms
-  the whole role;
-- manager self-failover state: worker records persist to
-  ``--state`` so a restarted driver re-attaches to live workers.
+- per-role SubMasters with gang restart (rollout marked elastic);
+- manager self-failover state via ``--state``.
 
-The worker entrypoints here are tiny self-contained functions (module
-``examples.unified_rl`` run with ``:role_main``) that write progress
-files; swap them for real JAX programs — the role env
-(DLROVER_TPU_ROLE / ROLE_RANK / ROLE_WORLD_SIZE / NODE_SLOT) carries
-each process's coordinates.
+Each worker writes a .done file with the checksums it saw so the driver
+(and tests) can verify the tensors actually flowed end to end.
 """
 
 import argparse
@@ -26,19 +29,107 @@ import os
 import sys
 import tempfile
 
+N_BATCHES = 4          # total rollout batches per run
+BATCH_SHAPE = (8, 16)  # toy rollout tensor
 
-def role_main():
-    """Shared toy entrypoint: identify the role, do 'work', exit 0."""
-    import time
 
-    role = os.environ["DLROVER_TPU_ROLE"]
-    rank = os.environ["DLROVER_TPU_ROLE_RANK"]
-    slot = os.environ.get("DLROVER_TPU_NODE_SLOT", "-1")
-    out = os.environ.get("RL_DEMO_OUT", tempfile.gettempdir())
-    time.sleep(0.5)
+def _done(out, role, rank, text):
     with open(os.path.join(out, f"{role}-{rank}.done"), "w") as f:
-        f.write(f"slot={slot}\n")
-    print(f"[{role}:{rank}] done on slot {slot}")
+        f.write(text)
+
+
+def rollout_main():
+    """Produce rollout tensors into the "rollouts" queue; tag each with
+    the actor's current weight version fetched over RPC."""
+    import numpy as np
+
+    from dlrover_tpu.unified import runtime
+
+    me = runtime.current_worker()
+    out = os.environ.get("RL_DEMO_OUT", tempfile.gettempdir())
+    q = runtime.get_queue("rollouts")
+    share = N_BATCHES // me.world_size
+    total = 0.0
+    for i in range(share):
+        version = runtime.rpc("actor", "get_version", rank=0)
+        rng = np.random.default_rng(me.rank * 1000 + i)
+        obs = rng.normal(size=BATCH_SHAPE).astype(np.float32)
+        q.put({"obs": obs, "producer": me.rank, "version": version})
+        total += float(obs.sum())
+    _done(out, me.role, me.rank, f"produced={share} checksum={total:.4f}\n")
+    print(f"[{me.role}:{me.rank}] produced {share} batches")
+
+
+def reward_main():
+    """Own the "rollouts" queue, score each batch, forward to
+    "scored"."""
+    import numpy as np
+
+    from dlrover_tpu.unified import runtime
+
+    me = runtime.current_worker()
+    out = os.environ.get("RL_DEMO_OUT", tempfile.gettempdir())
+    q = runtime.create_queue("rollouts")
+    scored_q = runtime.get_queue("scored")
+    total = 0.0
+    for _ in range(N_BATCHES):
+        item = q.get(timeout=120.0)
+        rewards = np.tanh(item["obs"].mean(axis=-1))
+        total += float(item["obs"].sum())
+        scored_q.put({**item, "rewards": rewards})
+    _done(out, me.role, me.rank,
+          f"scored={N_BATCHES} checksum={total:.4f}\n")
+    print(f"[{me.role}:{me.rank}] scored {N_BATCHES} batches")
+
+
+def actor_main():
+    """All ranks serve set_weights/get_version over RPC; rank 0 owns the
+    "scored" queue, consumes it, updates weights, and broadcasts them to
+    every actor with rpc_all."""
+    import threading
+
+    import numpy as np
+
+    from dlrover_tpu.unified import runtime
+
+    me = runtime.current_worker()
+    out = os.environ.get("RL_DEMO_OUT", tempfile.gettempdir())
+    state = {"version": 0,
+             "weights": np.zeros(BATCH_SHAPE[1], np.float32)}
+    applied = threading.Event()
+
+    def set_weights(w, version):
+        state["weights"] = w
+        state["version"] = version
+        if version >= N_BATCHES:
+            applied.set()
+        return version
+
+    runtime.export_rpc("set_weights", set_weights)
+    runtime.export_rpc("get_version", lambda: state["version"])
+
+    if me.rank == 0:
+        q = runtime.create_queue("scored")
+        for _ in range(N_BATCHES):
+            item = q.get(timeout=120.0)
+            # "Training": reward-weighted feature average into weights.
+            grad = (item["rewards"][:, None] * item["obs"]).mean(axis=0)
+            new_w = state["weights"] + 0.1 * grad
+            version = state["version"] + 1
+            acks = runtime.rpc_all(
+                "actor", "set_weights", new_w, version
+            )
+            assert acks == [version] * me.world_size, acks
+    # Every rank (including 0, via its own rpc_all ack) waits until the
+    # final weights arrived through the sanctioned channel.
+    if not applied.wait(timeout=120.0):
+        raise TimeoutError("final weights never arrived over RPC")
+    _done(
+        out, me.role, me.rank,
+        f"version={state['version']} "
+        f"wsum={float(state['weights'].sum()):.6f}\n",
+    )
+    print(f"[{me.role}:{me.rank}] final version {state['version']}")
 
 
 def main():
@@ -53,11 +144,11 @@ def main():
     job = (
         DLJobBuilder("rl-demo")
         .nnodes(2)
-        .actor("examples.unified_rl:role_main").total(2)
+        .actor("examples.unified_rl:actor_main").total(2)
         .env("RL_DEMO_OUT", out).add()
-        .rollout("examples.unified_rl:role_main").total(2)
+        .rollout("examples.unified_rl:rollout_main").total(2)
         .env("RL_DEMO_OUT", out).elastic().add()
-        .reward("examples.unified_rl:role_main").total(1)
+        .reward("examples.unified_rl:reward_main").total(1)
         .env("RL_DEMO_OUT", out).failover("ignore").add()
         .with_collocation("actor", "rollout")
         .master_state(ns.state)
@@ -65,7 +156,9 @@ def main():
     )
     master = submit(job)
     print("job finished:", master.status())
-    print("artifacts:", sorted(os.listdir(out)))
+    for name in sorted(os.listdir(out)):
+        with open(os.path.join(out, name)) as f:
+            print(f"  {name}: {f.read().strip()}")
 
 
 if __name__ == "__main__":
