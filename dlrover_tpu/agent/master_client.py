@@ -109,7 +109,8 @@ class MasterClient:
             comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
         )
         rank_order = getattr(resp, "rank_order", None) or list(resp.world)
-        return resp.round, resp.group, resp.world, rank_order
+        node_groups = getattr(resp, "node_groups", None) or {}
+        return resp.round, resp.group, resp.world, rank_order, node_groups
 
     @retry_rpc
     def num_nodes_waiting(self, rdzv_name: str) -> int:

@@ -38,6 +38,12 @@ class RendezvousOutcome:
     process_id_base: int  # first global process id of this node
     node_world_size: int  # number of nodes in the world
     is_coordinator: bool
+    # Slices (node groups) in this world — 1 when ungrouped. With the
+    # manager's group-major world order, a dcn mesh axis of this size
+    # maps one group per slice row (parallel/mesh.py). Derived from the
+    # master's node_groups (explicit DLROVER_TPU_NODE_GROUP or
+    # node_unit arithmetic — whichever grouped the rendezvous).
+    num_slices: int = 1
 
 
 class MasterRendezvousHandler:
@@ -88,10 +94,11 @@ class MasterRendezvousHandler:
         deadline = time.time() + self._join_timeout
         world: Dict[int, int] = {}
         rank_order: list = []
+        node_groups: Dict[int, int] = {}
         rdzv_round = 0
         group = 0
         while time.time() < deadline:
-            rdzv_round, group, world, rank_order = (
+            rdzv_round, group, world, rank_order, node_groups = (
                 self._client.get_comm_world(
                     self._rdzv_name, self._node_rank
                 )
@@ -153,7 +160,22 @@ class MasterRendezvousHandler:
             process_id_base=process_id_base,
             node_world_size=len(world),
             is_coordinator=is_coordinator,
+            num_slices=self._derive_num_slices(world, node_groups),
         )
+
+    def _derive_num_slices(self, world, node_groups) -> int:
+        """Distinct node groups in the world (explicit env grouping or
+        node_unit arithmetic — the master reports whichever grouped the
+        round); falls back to node_unit division for old masters."""
+        groups = {
+            g for r, g in (node_groups or {}).items()
+            if r in world and g >= 0
+        }
+        if groups:
+            return len(groups)
+        if self._node_unit > 1 and len(world) % self._node_unit == 0:
+            return len(world) // self._node_unit
+        return 1
 
     def _wait_coordinator(self, key: str, deadline: float) -> str:
         while time.time() < deadline:

@@ -162,6 +162,7 @@ class ElasticAgent:
                     restart_count=self._restart_count,
                     rdzv_round=outcome.round,
                     node_ranks=list(outcome.world),
+                    num_slices=outcome.num_slices,
                 )
             )
             if spec.entrypoint.startswith("-m "):

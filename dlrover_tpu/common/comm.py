@@ -86,6 +86,10 @@ class CommWorld(BaseResponse):
     # pickle wire format, and a future proto/JSON transport would
     # silently desynchronize ranks across nodes without this field.
     rank_order: List[int] = field(default_factory=list)
+    # node_rank -> slice/node-group id (-1 = ungrouped). Lets workers
+    # size the dcn mesh axis even when groups came from explicit
+    # DLROVER_TPU_NODE_GROUP env rather than node_unit arithmetic.
+    node_groups: Dict[int, int] = field(default_factory=dict)
 
 
 @dataclass

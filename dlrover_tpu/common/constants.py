@@ -202,6 +202,10 @@ class WorkerEnv:
     # Comma-separated node ranks of the current world (commit protocol
     # needs the ACTUAL membership, not arithmetic over process counts).
     NODE_RANKS = "DLROVER_TPU_NODE_RANKS"
+    # Node groups (TPU slices) in the world: with the group-major rank
+    # order, a dcn mesh axis of this size maps one group per slice row —
+    # what a worker needs to build a multi-slice mesh.
+    NUM_SLICES = "DLROVER_TPU_NUM_SLICES"
 
 
 class JobConstant:

@@ -74,6 +74,7 @@ def worker_env(
     restart_count: int = 0,
     rdzv_round: int = 0,
     node_ranks=None,
+    num_slices: int = 1,
 ) -> dict:
     """Build the env block the agent injects into each JAX worker."""
     env = {
@@ -84,6 +85,7 @@ def worker_env(
         WorkerEnv.LOCAL_WORLD_SIZE: str(local_world_size),
         WorkerEnv.RESTART_COUNT: str(restart_count),
         WorkerEnv.RDZV_ROUND: str(rdzv_round),
+        WorkerEnv.NUM_SLICES: str(num_slices),
     }
     if node_ranks:
         env[WorkerEnv.NODE_RANKS] = ",".join(str(r) for r in node_ranks)

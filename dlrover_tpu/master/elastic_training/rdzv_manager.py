@@ -218,6 +218,12 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
     def __init__(self):
         super().__init__(RendezvousName.TRAINING)
         self._topology_sorter = None
+        self._latest_groups = {}
+
+    def latest_node_groups(self):
+        """node_rank -> node_group of the latest completed world."""
+        with self._lock:
+            return dict(self._latest_groups)
 
     def set_topology_sorter(self, sorter):
         """Install a TopologySorter (net_topology.DpTopologySorter): the
@@ -227,6 +233,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
 
     def _order_world(self, world: Dict[int, int], chosen) -> Dict[int, int]:
         groups = {w.node_rank: w.node_group for w in chosen}
+        self._latest_groups = groups
         if any(g >= 0 for g in groups.values()):
             # Group-major order: each slice's hosts are contiguous in
             # the rank order, so dp/allreduce neighbors ride ICI and

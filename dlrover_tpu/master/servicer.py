@@ -168,12 +168,14 @@ class MasterServicer(MasterService):
             return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
         rdzv_round, group, world = mgr.get_comm_world(req.node_id)
         rank_order = list(world)
+        groups_fn = getattr(mgr, "latest_node_groups", None)
         return comm.CommWorld(
             round=rdzv_round,
             group=group,
             world=world,
             coordinator_rank=rank_order[0] if rank_order else -1,
             rank_order=rank_order,
+            node_groups=groups_fn() if groups_fn else {},
         )
 
     def _num_nodes_waiting(self, msg, req: comm.NumNodesWaitingRequest):

@@ -326,9 +326,13 @@ def transformer_layer(
 
 
 def embed_tokens(config, params, tokens):
-    x = jnp.take(params["embed"], tokens, axis=0).astype(
-        config.compute_dtype
-    )
+    # Release the table's FSDP (embed-over-dp) sharding BEFORE the
+    # gather: the [vocab, d] all-gather is cheap, while letting GSPMD
+    # reshard the [b, s, d] gather output (which inherits the table's
+    # embed sharding) triggers involuntary full rematerialization on
+    # meshes where batch/seq/embed axes all move (observed on sp).
+    table = with_logical_constraint(params["embed"], ("vocab", None))
+    x = jnp.take(table, tokens, axis=0).astype(config.compute_dtype)
     return with_logical_constraint(x, ("batch", "seq", "embed"))
 
 

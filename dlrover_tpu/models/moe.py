@@ -101,6 +101,13 @@ def moe_mlp(
     h = jax.nn.silu(h) * u
     h = with_logical_constraint(h, ("expert", "batch", "capacity", "mlp"))
     expert_out = jnp.einsum("egcf,efd->egcd", h, w_down.astype(x.dtype))
+    # Without this constraint GSPMD infers an (e, d)-sharded layout from
+    # w_down and then can't reshard the backward cotangent (which
+    # arrives batch-sharded from dout) efficiently — involuntary full
+    # rematerialization on the ep mesh.
+    expert_out = with_logical_constraint(
+        expert_out, ("expert", "batch", "capacity", "embed")
+    )
     out = jnp.einsum("egcd,gsec->gsd", expert_out, combine)
     out = with_logical_constraint(out, ("batch", "seq", "embed"))
 

@@ -3,7 +3,14 @@
 Axis convention (slowest-varying first; ``tp`` innermost so tensor-parallel
 collectives ride the fastest ICI links):
 
-- ``dp``: data parallel / FSDP (params' embed dim sharded here, ZeRO-style)
+- ``dcn``: the SLICE axis — data parallel across TPU slices over DCN
+  (data-center network). Only batch rides it; every other axis stays
+  inside a slice so its collectives ride ICI. Group-major rendezvous
+  rank order (rdzv_manager._order_world) makes each node group's hosts
+  contiguous, which is exactly the layout that maps groups onto dcn
+  rows here.
+- ``dp``: data parallel / FSDP within a slice (params' embed dim
+  sharded here, ZeRO-style)
 - ``ep``: expert parallel; also an extra batch axis outside MoE layers
 - ``pp``: pipeline stages
 - ``sp``: sequence/context parallel (ring attention)
@@ -18,10 +25,11 @@ import dataclasses
 import math
 from typing import List, Optional, Sequence, Tuple
 
-AXIS_NAMES = ("dp", "ep", "pp", "sp", "tp")
+AXIS_NAMES = ("dcn", "dp", "ep", "pp", "sp", "tp")
 
-# Batch is sharded over both pure-data and expert axes.
-BATCH_AXES = ("dp", "ep")
+# Batch is sharded over the slice axis plus both pure-data and expert
+# axes.
+BATCH_AXES = ("dcn", "dp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,10 +41,11 @@ class MeshConfig:
     pp: int = 1
     sp: int = 1
     tp: int = 1
+    dcn: int = 1  # slices (inter-slice data parallel over DCN)
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.ep, self.pp, self.sp, self.tp)
+        return (self.dcn, self.dp, self.ep, self.pp, self.sp, self.tp)
 
     @property
     def num_devices(self) -> int:
@@ -44,7 +53,11 @@ class MeshConfig:
 
     @property
     def data_parallel_size(self) -> int:
-        return self.dp * self.ep
+        return self.dcn * self.dp * self.ep
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self.num_devices // self.dcn
 
     def describe(self) -> str:
         return "x".join(
@@ -55,9 +68,12 @@ class MeshConfig:
 def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
     """Build a ``jax.sharding.Mesh`` with the canonical axis order.
 
-    On real TPU hardware, uses ``mesh_utils.create_device_mesh`` so the
-    logical mesh respects the physical ICI topology; on CPU/virtual
-    devices falls back to a plain reshape.
+    On real TPU hardware, uses ``mesh_utils.create_device_mesh`` (single
+    slice) or ``create_hybrid_device_mesh`` (dcn > 1: per-slice ICI
+    meshes glued along the slice axis) so the logical mesh respects the
+    physical topology; on CPU/virtual devices falls back to a plain
+    reshape — devices arriving in group-major rank order land one node
+    group per dcn row.
     """
     import jax
     import numpy as np
@@ -74,9 +90,16 @@ def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
     if devices and devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(
-            config.shape, devices=devices
-        )
+        ici_shape = (1,) + config.shape[1:]
+        if config.dcn > 1:
+            dcn_shape = (config.dcn,) + (1,) * (len(config.shape) - 1)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices
+            )
     else:
         dev_array = np.asarray(devices).reshape(config.shape)
     return Mesh(dev_array, AXIS_NAMES)
@@ -143,3 +166,28 @@ def largest_legal_hosts(available_hosts: int, chips_per_host: int = 4) -> int:
     """Largest power-of-two host count <= available (0 if none)."""
     shapes = legal_mesh_shapes(available_hosts, chips_per_host)
     return shapes[-1][0] if shapes else 0
+
+
+def mesh_config_for_slices(
+    num_devices: int,
+    num_slices: int = 1,
+    max_tp: int = 8,
+    max_pp: int = 1,
+    want_sp: bool = False,
+    want_ep: bool = False,
+) -> MeshConfig:
+    """Multi-slice mesh recipe: data parallel across slices over DCN
+    (``dcn=num_slices``), everything else factorized INSIDE a slice so
+    its collectives ride ICI. ``num_slices`` usually comes from
+    ``DistributedContext.num_slices`` (node groups / node_unit).
+    """
+    if num_devices % max(num_slices, 1):
+        raise ValueError(
+            f"{num_devices} devices not divisible by {num_slices} slices"
+        )
+    per_slice = num_devices // max(num_slices, 1)
+    intra = factorize_devices(
+        per_slice, max_tp=max_tp, max_pp=max_pp,
+        want_sp=want_sp, want_ep=want_ep,
+    )
+    return dataclasses.replace(intra, dcn=max(num_slices, 1))

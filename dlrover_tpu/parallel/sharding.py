@@ -15,8 +15,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 MeshAxes = Union[None, str, Tuple[str, ...]]
 
 # logical axis -> mesh axis (or tuple of mesh axes)
+# Batch leads with the slice (dcn) axis: inter-slice traffic is then
+# only the data-parallel gradient allreduce; FSDP (embed -> dp), tp, sp
+# and ep all stay intra-slice on ICI.
 DEFAULT_RULES: Tuple[Tuple[str, MeshAxes], ...] = (
-    ("batch", ("dp", "ep")),
+    ("batch", ("dcn", "dp", "ep")),
     ("seq", "sp"),
     ("embed", "dp"),       # FSDP: params' embed dim sharded over dp (ZeRO)
     ("heads", "tp"),

@@ -29,11 +29,13 @@ class DistributedContext:
     restart_count: int
     rdzv_round: int
     node_ranks: tuple = ()
+    num_slices: int = 1
     initialized_jax_distributed: bool = False
 
     @property
     def is_leader(self) -> bool:
         return self.process_id == 0
+
 
 
 _context: Optional[DistributedContext] = None
@@ -53,6 +55,7 @@ def read_worker_env() -> DistributedContext:
             for r in os.getenv(WorkerEnv.NODE_RANKS, "").split(",")
             if r.strip()
         ),
+        num_slices=int(os.getenv(WorkerEnv.NUM_SLICES, "1")),
     )
 
 

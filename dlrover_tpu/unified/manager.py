@@ -115,16 +115,21 @@ class PrimeManager:
         # Publish the role -> world-size manifest so the in-worker data
         # plane's rpc_all (unified/rpc.py) can fan out before every
         # worker has registered.
-        from dlrover_tpu.unified.backend import RayBackend
         from dlrover_tpu.unified.rpc import write_manifest
 
         write_manifest(
             self.config.job_name,
             {r.name: r.total for r in self.config.roles},
-            backend="ray" if isinstance(self.backend, RayBackend)
-            else "local",
+            backend=self._registry_backend(),
         )
         self.stage = JobStage.READY
+
+    def _registry_backend(self) -> str:
+        """Which runtime-registry implementation this job's workers use
+        (must match the UnifiedEnv.BACKEND the backend injects)."""
+        from dlrover_tpu.unified.backend import RayBackend
+
+        return "ray" if isinstance(self.backend, RayBackend) else "local"
 
     def start(self):
         """READY -> RUNNING.
@@ -145,14 +150,11 @@ class PrimeManager:
             # previous run of this job name (live ones survive a
             # self-failover resume untouched).
             try:
-                from dlrover_tpu.unified.backend import RayBackend
                 from dlrover_tpu.unified.rpc import create_registry
 
                 create_registry(
                     self.config.job_name,
-                    backend="ray"
-                    if isinstance(self.backend, RayBackend)
-                    else "local",
+                    backend=self._registry_backend(),
                 ).clear()
             except Exception:  # noqa: BLE001 - best-effort hygiene
                 pass

@@ -86,7 +86,21 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 req = _recv(self.request)
-                _send(self.request, endpoint.dispatch(req))
+                rsp = endpoint.dispatch(req)
+                try:
+                    # _send serializes fully before any byte hits the
+                    # wire, so a pickling failure leaves the stream
+                    # clean — report it instead of killing the
+                    # connection (which would push the client into its
+                    # reconnect-and-re-execute path).
+                    _send(self.request, rsp)
+                except (pickle.PicklingError, TypeError,
+                        AttributeError) as e:
+                    _send(self.request, {
+                        "ok": False,
+                        "error": f"unpicklable reply: "
+                                 f"{type(e).__name__}: {e}",
+                    })
         except (ConnectionError, OSError):
             pass
         finally:
@@ -336,7 +350,9 @@ class RayRegistry:
 
 
 def create_registry(job_name: str, backend: Optional[str] = None):
-    backend = backend or os.getenv("DLROVER_TPU_UNIFIED_BACKEND", "local")
+    from dlrover_tpu.unified.backend import UnifiedEnv
+
+    backend = backend or os.getenv(UnifiedEnv.BACKEND, "local")
     if backend == "ray":
         return RayRegistry(job_name)
     return FileRegistry(job_name)
@@ -408,7 +424,15 @@ class QueueHandle:
                 # Registration timeout, not a request timeout — must not
                 # be caught by the callers' no-resend TimeoutError path.
                 raise RpcError(str(e)) from None
-            self._conn = _Conn(addr, self._resolve_timeout)
+            try:
+                self._conn = _Conn(addr, self._resolve_timeout)
+            except TimeoutError as e:
+                # Connect-phase timeout (black-holed address): nothing
+                # was sent, so this is safely retryable — route it into
+                # the callers' dead-peer path, not the no-resend one.
+                raise ConnectionError(
+                    f"connect to {addr} timed out"
+                ) from e
         return self._conn
 
     def _call(self, req: dict, timeout: Optional[float]) -> dict:
@@ -494,7 +518,12 @@ class RuntimeClient:
             # Registration timeout, not a request timeout — keep it out
             # of the callers' no-resend TimeoutError path.
             raise RpcError(str(e)) from None
-        conn = _Conn(addr, self._resolve_timeout)
+        try:
+            conn = _Conn(addr, self._resolve_timeout)
+        except TimeoutError as e:
+            # Connect-phase timeout: nothing sent — retryable, so route
+            # it into the dead-peer path, not the no-resend one.
+            raise ConnectionError(f"connect to {addr} timed out") from e
         with self._lock:
             self._conns[key] = conn
         return conn

@@ -1,0 +1,136 @@
+"""Multi-slice (DCN) meshes: slice axis layout, training, and the
+group-major rendezvous order mapping node groups onto dcn rows.
+
+SURVEY §2.9 TPU equivalents: ICI intra-slice, DCN inter-slice. The dcn
+mesh axis carries only the batch (data-parallel gradient allreduce);
+fsdp/tp/sp/ep collectives stay inside a slice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.sharding import logical_to_spec
+from dlrover_tpu.trainer import train_step as ts
+
+
+def test_batch_rule_leads_with_dcn():
+    spec = logical_to_spec(("batch", "seq", "embed"))
+    assert spec[0] == ("dcn", "dp", "ep")
+    # embed (FSDP) must NOT touch the slice axis.
+    assert logical_to_spec(("embed", "vocab"))[0] == "dp"
+
+
+def test_dcn_mesh_places_groups_on_slice_rows():
+    """Devices arriving in group-major rank order land one node group
+    per dcn row — the property the group-major rendezvous order exists
+    to provide."""
+    devices = jax.devices()
+    mesh = build_mesh(MeshConfig(dcn=2, dp=2, tp=2), devices)
+    assert mesh.axis_names == ("dcn", "dp", "ep", "pp", "sp", "tp")
+    slice0 = mesh.devices[0].flatten().tolist()
+    slice1 = mesh.devices[1].flatten().tolist()
+    assert slice0 == devices[:4]
+    assert slice1 == devices[4:]
+
+
+def test_train_step_on_dcn_mesh():
+    mesh = build_mesh(MeshConfig(dcn=2, dp=2, tp=2))
+    cfg = llama.tiny_config(n_layers=2)
+    tc = ts.TrainConfig(learning_rate=5e-3, warmup_steps=2)
+    opt = ts.make_optimizer(tc)
+    state, specs = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
+    step, _ = ts.make_train_step(cfg, tc, opt, mesh)
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 33), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, {"tokens": tokens})
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.3, losses
+    # Params (FSDP over dp) are replicated across the slice axis: the
+    # embed table's sharding must not involve dcn.
+    embed = state["params"]["embed"]
+    spec = embed.sharding.spec
+    assert "dcn" not in str(spec), spec
+
+
+def test_group_major_world_order_maps_onto_dcn_axis():
+    """End to end: nodes join rendezvous with node_group set; the world
+    comes back group-major; laying devices out in that rank order puts
+    each group in exactly one dcn row."""
+    from dlrover_tpu.master.elastic_training.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4)
+    # Join in scrambled order; groups: nodes 0,2 -> group 1; 1,3 -> 0.
+    group_of = {0: 1, 2: 1, 1: 0, 3: 0}
+    for rank in (2, 0, 3, 1):
+        mgr.join_rendezvous(
+            node_id=rank, node_rank=rank, local_world_size=2,
+            node_group=group_of[rank],
+        )
+    _, _, world = mgr.get_comm_world(0)
+    ranks = list(world)
+    # group-major: group 0's nodes (1, 3) precede group 1's (0, 2).
+    assert ranks == [1, 3, 0, 2], ranks
+
+    # Each node contributes local_world_size=2 devices; in world order
+    # the 8 virtual devices split so each GROUP owns one dcn row.
+    devices = jax.devices()
+    rank_of_device = [r for r in ranks for _ in range(2)]
+    mesh = build_mesh(MeshConfig(dcn=2, dp=2, tp=2), devices)
+    for slice_idx in range(2):
+        slice_devs = mesh.devices[slice_idx].flatten().tolist()
+        groups = {
+            group_of[rank_of_device[devices.index(d)]]
+            for d in slice_devs
+        }
+        assert len(groups) == 1, (
+            f"slice {slice_idx} spans groups {groups}"
+        )
+
+
+def test_mesh_config_for_slices_recipe():
+    from dlrover_tpu.parallel.mesh import mesh_config_for_slices
+
+    mc = mesh_config_for_slices(8, num_slices=2, max_tp=2)
+    assert mc.dcn == 2 and mc.num_devices == 8
+    assert mc.devices_per_slice == 4
+    assert mc.tp <= 2
+    mesh = build_mesh(mc)
+    assert dict(mesh.shape)["dcn"] == 2
+
+
+def test_context_num_slices_env(monkeypatch):
+    from dlrover_tpu.common.constants import WorkerEnv
+    from dlrover_tpu.trainer.runtime import read_worker_env
+
+    monkeypatch.setenv(WorkerEnv.NUM_SLICES, "2")
+    assert read_worker_env().num_slices == 2
+    monkeypatch.delenv(WorkerEnv.NUM_SLICES)
+    assert read_worker_env().num_slices == 1
+
+
+def test_agent_derives_num_slices_from_groups():
+    """The rendezvous handler sizes the dcn axis from the master's
+    reported node groups (explicit env grouping), falling back to
+    node_unit arithmetic."""
+    from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
+
+    h = MasterRendezvousHandler.__new__(MasterRendezvousHandler)
+    h._node_unit = 1
+    world = {0: 2, 1: 2, 2: 2, 3: 2}
+    # Explicit groups win even with node_unit == 1.
+    assert h._derive_num_slices(world, {0: 1, 1: 1, 2: 0, 3: 0}) == 2
+    # Ungrouped (-1) worlds are one slice.
+    assert h._derive_num_slices(world, {r: -1 for r in world}) == 1
+    # Old-master fallback: node_unit division.
+    h._node_unit = 2
+    assert h._derive_num_slices(world, {}) == 2

@@ -42,13 +42,13 @@ def test_rendezvous_round(master, client):
     c1 = _mk_client(master, 1)
     client.join_rendezvous(0, 1, RendezvousName.TRAINING)
     c1.join_rendezvous(1, 1, RendezvousName.TRAINING)
-    rnd, group, world, order = client.get_comm_world(
+    rnd, group, world, order, groups = client.get_comm_world(
         RendezvousName.TRAINING, 0
     )
     assert world == {0: 1, 1: 1}
     assert order == list(world)
     # second node sees the same completed round
-    rnd2, _, world2, _ = c1.get_comm_world(RendezvousName.TRAINING, 1)
+    rnd2, _, world2, _, _ = c1.get_comm_world(RendezvousName.TRAINING, 1)
     assert world2 == world
     assert rnd2 == rnd
     assert client.num_nodes_waiting(RendezvousName.TRAINING) == 0
@@ -154,7 +154,7 @@ def test_http_transport_full_protocol():
         c.kv_store_set("hk", b"v1")
         assert c.kv_store_get("hk") == b"v1"
         c.join_rendezvous(0, 1, RendezvousName.TRAINING)
-        _, _, world, _ = c.get_comm_world(RendezvousName.TRAINING, 0)
+        _, _, world, _, _ = c.get_comm_world(RendezvousName.TRAINING, 0)
         assert world == {0: 1}
         c.close()
     finally:
