@@ -166,13 +166,29 @@ class MasterRendezvousHandler:
     def _derive_num_slices(self, world, node_groups) -> int:
         """Distinct node groups in the world (explicit env grouping or
         node_unit arithmetic — the master reports whichever grouped the
-        round); falls back to node_unit division for old masters."""
-        groups = {
-            g for r, g in (node_groups or {}).items()
-            if r in world and g >= 0
-        }
-        if groups:
-            return len(groups)
+        round); falls back to node_unit division for old masters.
+
+        A dcn mesh row must hold exactly one slice, so the grouping only
+        counts when it PARTITIONS the world into equal-sized groups with
+        no ungrouped nodes — an uneven world (mid-failover, or one host
+        missing its group env) would otherwise get a mesh whose
+        "intra-slice" collectives silently cross DCN. Such worlds run as
+        a single slice instead.
+        """
+        groups = {r: g for r, g in (node_groups or {}).items() if r in world}
+        if groups and len(groups) == len(world):
+            ids = list(groups.values())
+            if min(ids) >= 0:
+                counts = {}
+                for g in ids:
+                    counts[g] = counts.get(g, 0) + 1
+                if len(set(counts.values())) == 1:
+                    return len(counts)
+                logger.warning(
+                    "uneven node groups %s — running as one slice",
+                    counts,
+                )
+                return 1
         if self._node_unit > 1 and len(world) % self._node_unit == 0:
             return len(world) // self._node_unit
         return 1

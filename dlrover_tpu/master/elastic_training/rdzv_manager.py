@@ -225,6 +225,14 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
         with self._lock:
             return dict(self._latest_groups)
 
+    def get_comm_world_and_groups(self, node_rank: int):
+        """(round, group, world, node_groups) under ONE lock hold — a
+        round completing between separate calls would pair round-N's
+        world with round-N+1's groups."""
+        with self._lock:
+            rdzv_round, group, world = self.get_comm_world(node_rank)
+            return rdzv_round, group, world, dict(self._latest_groups)
+
     def set_topology_sorter(self, sorter):
         """Install a TopologySorter (net_topology.DpTopologySorter): the
         completed world's ORDER then follows physical blocks, and agents

@@ -166,16 +166,20 @@ class MasterServicer(MasterService):
         mgr = self._rdzv_managers.get(req.rdzv_name)
         if mgr is None:
             return comm.BaseResponse(False, f"unknown rdzv {req.rdzv_name}")
-        rdzv_round, group, world = mgr.get_comm_world(req.node_id)
+        atomic = getattr(mgr, "get_comm_world_and_groups", None)
+        if atomic is not None:
+            rdzv_round, group, world, node_groups = atomic(req.node_id)
+        else:
+            rdzv_round, group, world = mgr.get_comm_world(req.node_id)
+            node_groups = {}
         rank_order = list(world)
-        groups_fn = getattr(mgr, "latest_node_groups", None)
         return comm.CommWorld(
             round=rdzv_round,
             group=group,
             world=world,
             coordinator_rank=rank_order[0] if rank_order else -1,
             rank_order=rank_order,
-            node_groups=groups_fn() if groups_fn else {},
+            node_groups=node_groups,
         )
 
     def _num_nodes_waiting(self, msg, req: comm.NumNodesWaitingRequest):

@@ -47,6 +47,10 @@ class TpuLMConfig:
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # "gshard": one-hot dispatch with capacity drop (works under ep
+    # meshes); "dropless": megablox grouped matmul, zero drops (ep == 1
+    # only); "auto": dropless when the mesh has no ep axis.
+    moe_impl: str = "auto"
     # pipeline: layer stack is stored [stages, layers_per_stage, ...]
     pp_stages: int = 1
     num_microbatches: int = 1
@@ -67,6 +71,11 @@ class TpuLMConfig:
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in ('mlp_only', "
                 f"'dots', 'full') — a typo here silently costs MFU"
+            )
+        if self.moe_impl not in ("auto", "gshard", "dropless"):
+            raise ValueError(
+                f"moe_impl {self.moe_impl!r} not in ('auto', 'gshard', "
+                f"'dropless')"
             )
 
     @property
@@ -98,6 +107,20 @@ class TpuLMConfig:
             self.n_layers * per_layer
             + 2 * self.vocab_size * d
             + d
+        )
+
+    def count_active_params(self) -> int:
+        """Params a single token actually touches — for MoE, top_k
+        experts instead of all of them (the honest 6N basis for MoE
+        MFU; equals count_params() for dense configs)."""
+        if self.n_experts == 0:
+            return self.count_params()
+        d = self.embed_dim
+        dense_mlp = 3 * d * self.mlp_dim
+        all_mlp = dense_mlp * self.n_experts
+        active_mlp = dense_mlp * self.moe_top_k
+        return self.count_params() - self.n_layers * (
+            all_mlp - active_mlp
         )
 
 
@@ -273,6 +296,20 @@ def attention_out(config: TpuLMConfig, p, attn, residual):
     return with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
+def _moe_use_dropless(config) -> bool:
+    """Dropless grouped-matmul MoE needs data-dependent group sizes,
+    which GSPMD cannot shard over an ep axis — auto picks it only when
+    the mesh has no expert parallelism."""
+    if config.moe_impl == "dropless":
+        return True
+    if config.moe_impl == "gshard":
+        return False
+    from dlrover_tpu.parallel.sharding import current_mesh
+
+    mesh = current_mesh()
+    return mesh is None or dict(mesh.shape).get("ep", 1) == 1
+
+
 def mlp_block(config: TpuLMConfig, p, x):
     """Residual MLP (dense or MoE). Returns (x, aux). Shared with the
     decode path."""
@@ -280,15 +317,25 @@ def mlp_block(config: TpuLMConfig, p, x):
     residual = x
     hx = rms_norm(x, p["mlp_norm"]).astype(cdt)
     if config.n_experts > 0:
-        out, metrics = moe_lib.moe_mlp(
-            hx,
-            p["router"],
-            p["w_gate"],
-            p["w_up"],
-            p["w_down"],
-            top_k=config.moe_top_k,
-            capacity_factor=config.capacity_factor,
-        )
+        if _moe_use_dropless(config):
+            out, metrics = moe_lib.moe_mlp_dropless(
+                hx,
+                p["router"],
+                p["w_gate"],
+                p["w_up"],
+                p["w_down"],
+                top_k=config.moe_top_k,
+            )
+        else:
+            out, metrics = moe_lib.moe_mlp(
+                hx,
+                p["router"],
+                p["w_gate"],
+                p["w_up"],
+                p["w_down"],
+                top_k=config.moe_top_k,
+                capacity_factor=config.capacity_factor,
+            )
         aux = metrics.aux_loss + 0.001 * metrics.router_z_loss
     else:
         g = jnp.einsum("bsd,df->bsf", hx, p["w_gate"].astype(cdt))

@@ -1,16 +1,27 @@
-"""Mixture-of-Experts MLP with GShard-style einsum dispatch.
+"""Mixture-of-Experts MLP: GShard einsum dispatch and a dropless
+grouped-matmul path.
 
-Expert-parallel by construction: expert-stacked weights carry a leading
-("expert",) logical axis mapped to the ``ep`` mesh axis, and the dispatch/
-combine einsums contract token axes (sharded over dp/ep) against expert
-axes (sharded over ep) — XLA lowers the resharding to all-to-all over ICI.
-No per-token Python control flow: top-k and capacity assignment are
-one-hot einsum algebra, so everything stays on the MXU with static shapes.
+Two implementations behind one surface:
+
+- **gshard** (:func:`moe_mlp`): one-hot einsum dispatch with per-expert
+  capacity; over-capacity tokens are dropped (residual carries them).
+  Expert-parallel by construction — the dispatch/combine einsums
+  contract token axes (sharded over dp/ep) against expert axes (ep), so
+  GSPMD lowers the resharding to all-to-all over ICI. Static shapes,
+  works under any mesh.
+- **dropless** (:func:`moe_mlp_dropless`): megablox-style — sort token
+  copies by expert and run grouped (ragged) matmuls
+  (``jax.experimental.pallas.ops.tpu.megablox.gmm``), so NO token is
+  ever dropped and no capacity/one-hot FLOPs are wasted. Group sizes
+  are data-dependent, which GSPMD cannot shard over ``ep`` — this path
+  is for meshes with ep == 1 (each device holds all experts; dp/tp as
+  usual). ``models/llama.mlp_block`` picks it automatically there.
 
 The reference has no MoE/EP support (SURVEY.md section 2.9: "absent") —
 this is parity-plus for the TPU build.
 """
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -111,16 +122,116 @@ def moe_mlp(
     out = jnp.einsum("egcd,gsec->gsd", expert_out, combine)
     out = with_logical_constraint(out, ("batch", "seq", "embed"))
 
-    # --- router losses ---------------------------------------------------
-    # load-balance (Switch): E * sum_e fraction_tokens_e * mean_prob_e
-    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32)
-    frac_tokens = jnp.mean(top1, axis=(0, 1))
-    mean_probs = jnp.mean(probs, axis=(0, 1))
-    aux = e * jnp.sum(frac_tokens * mean_probs)
-    z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+    # --- router losses (shared with the dropless path) -------------------
+    aux, z = _router_losses(router_logits, probs)
     metrics = MoEMetrics(
         aux_loss=aux,
         router_z_loss=z,
         dropped_fraction=dropped / top_k,
+    )
+    return out, metrics
+
+
+# ---------------------------------------------------------------------------
+# Dropless path: sort-by-expert + grouped matmul (megablox gmm)
+# ---------------------------------------------------------------------------
+
+
+def _router_losses(router_logits, probs):
+    e = probs.shape[-1]
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=tuple(range(top1.ndim - 1)))
+    mean_probs = jnp.mean(probs, axis=tuple(range(probs.ndim - 1)))
+    aux = e * jnp.sum(frac_tokens * mean_probs)
+    z = jnp.mean(
+        jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2
+    )
+    return aux, z
+
+
+def _tile(dim: int, cap: int = 512) -> int:
+    """Largest power-of-two divisor of ``dim``, capped — gmm requires
+    every dimension to be tile-divisible."""
+    t = 1
+    while t * 2 <= min(dim, cap) and dim % (t * 2) == 0:
+        t *= 2
+    return t
+
+
+def moe_mlp_dropless(
+    x,
+    router_w,     # [embed, experts]
+    w_gate,       # [experts, embed, mlp]
+    w_up,         # [experts, embed, mlp]
+    w_down,       # [experts, mlp, embed]
+    top_k: int = 2,
+    interpret=None,
+):
+    """x: [batch, seq, embed] -> (out, MoEMetrics). Zero dropped tokens.
+
+    Token copies are stably sorted by their routed expert; the three
+    expert matmuls then run as ONE grouped matmul each over the sorted
+    rows (megablox gmm: contiguous per-expert row groups hit the MXU
+    with no one-hot dispatch algebra and no capacity padding). The
+    scatter back is a segment-sum over the k copies of each token.
+    """
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, d = x.shape
+    e = router_w.shape[-1]
+    n = b * s
+    m = n * top_k
+    xf = x.reshape(n, d)
+
+    router_logits = jnp.einsum(
+        "nd,de->ne", xf.astype(jnp.float32),
+        router_w.astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gates, experts = jax.lax.top_k(probs, top_k)        # [n, k]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+    )
+
+    flat_expert = experts.reshape(m)
+    order = jnp.argsort(flat_expert, stable=True)       # [m]
+    token_of = order // top_k
+    xs = jnp.take(xf, token_of, axis=0)                 # [m, d] sorted
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    # gmm needs tile-divisible dims; pad the row dim with zero rows
+    # assigned to the LAST group (sorted order keeps them contiguous at
+    # the end) and slice them off before the scatter.
+    f = w_gate.shape[-1]
+    m_pad = ((m + 127) // 128 * 128) if m >= 128 else m
+    if m_pad != m:
+        xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
+        group_sizes = group_sizes.at[e - 1].add(m_pad - m)
+    tiling = (_tile(m_pad), _tile(d), _tile(f))
+    run = functools.partial(gmm, interpret=interpret, tiling=tiling)
+    cdt = x.dtype
+    h = run(xs, w_gate.astype(cdt), group_sizes)
+    u = run(xs, w_up.astype(cdt), group_sizes)
+    a = (jax.nn.silu(h) * u).astype(cdt)
+    out_sorted = run(
+        a, w_down.astype(cdt), group_sizes,
+        tiling=(_tile(m_pad), _tile(f), _tile(d)),
+    )[:m]                                               # [m, d] f32
+
+    gate_sorted = gates.reshape(m)[order].astype(out_sorted.dtype)
+    out = jnp.zeros((n, d), out_sorted.dtype).at[token_of].add(
+        out_sorted * gate_sorted[:, None]
+    )
+    out = with_logical_constraint(
+        out.astype(x.dtype).reshape(b, s, d), ("batch", "seq", "embed")
+    )
+
+    aux, z = _router_losses(router_logits, probs)
+    metrics = MoEMetrics(
+        aux_loss=aux,
+        router_z_loss=z,
+        dropped_fraction=jnp.zeros((), jnp.float32),
     )
     return out, metrics

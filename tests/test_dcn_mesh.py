@@ -131,6 +131,16 @@ def test_agent_derives_num_slices_from_groups():
     assert h._derive_num_slices(world, {0: 1, 1: 1, 2: 0, 3: 0}) == 2
     # Ungrouped (-1) worlds are one slice.
     assert h._derive_num_slices(world, {r: -1 for r in world}) == 1
+    # UNEVEN groups (mid-failover world) must not claim slices: a dcn
+    # row would span slices and "ICI" collectives would cross DCN.
+    world5 = {0: 2, 1: 2, 2: 2, 3: 2, 4: 2}
+    assert h._derive_num_slices(
+        world5, {0: 0, 1: 0, 2: 0, 3: 1, 4: 1}
+    ) == 1
+    # A node missing its group id also demotes to one slice.
+    assert h._derive_num_slices(
+        world, {0: 0, 1: 0, 2: 1, 3: -1}
+    ) == 1
     # Old-master fallback: node_unit division.
     h._node_unit = 2
     assert h._derive_num_slices(world, {}) == 2
