@@ -244,6 +244,88 @@ def ring_inner_ab_phase():
 
 
 # ---------------------------------------------------------------------------
+# Phase 1f: profiler capture overhead (reference xpu_timer claims <=0.5%)
+# ---------------------------------------------------------------------------
+
+
+def profiler_overhead_phase():
+    """Train the flagship model twice — once clean, once with exactly
+    one XLA capture window landing mid-run — and report the capture's
+    cost plus the amortized overhead at the listener's default 60s
+    cadence (reference xpu_timer/README.md:20 publishes <=0.5%)."""
+    import threading
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer import train_step as ts
+    from dlrover_tpu.tpu_timer.xla_capture import capture_device_events
+
+    cfg = llama.TpuLMConfig(
+        vocab_size=32000, embed_dim=1024, n_layers=16, n_heads=8,
+        n_kv_heads=8, head_dim=128, mlp_dim=4096, dtype="bfloat16",
+    )
+    batch, seq, steps = 8, 2048, 12
+    mesh = build_mesh(MeshConfig(dp=len(jax.devices())), jax.devices())
+    tc = ts.TrainConfig(warmup_steps=10)
+    opt = ts.make_optimizer(tc)
+    state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
+    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=True)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    bd = {"tokens": tokens}
+    state, m = step_fn(state, bd)
+    float(m["loss"])
+
+    def run_steps():
+        # Per-step host fetch: the profiler needs a bounded dispatch
+        # queue to attribute device events (and both runs pay the same
+        # sync cost, so the delta isolates the capture).
+        nonlocal state
+        t0 = _t.time()
+        for _ in range(steps):
+            state, mm = step_fn(state, bd)
+            float(mm["loss"])
+        return _t.time() - t0
+
+    t_off = run_steps()
+    captured = []
+    # The measured window must (a) be the listener's DEFAULT window so
+    # numerator and denominator describe the same operating point, and
+    # (b) fit entirely inside the timed run — a window spilling past the
+    # last step would profile idle time and "confirm" zero overhead
+    # vacuously.
+    window_s = float(os.environ.get("DLROVER_TPU_TIMER_XLA_WINDOW", "1.0"))
+    window_s = min(window_s, max(t_off * 0.4, 0.2))
+
+    def one_capture():
+        _t.sleep(t_off * 0.2)
+        captured.append(len(capture_device_events(capture_s=window_s)))
+
+    th = threading.Thread(target=one_capture)
+    th.start()
+    t_on = run_steps()
+    th.join()
+    del state
+    cost_ms = max(t_on - t_off, 0.0) * 1e3
+    default_interval = float(
+        os.environ.get("DLROVER_TPU_TIMER_XLA_INTERVAL", "60")
+    )
+    return {
+        "profiler_capture_cost_ms": round(cost_ms, 1),
+        "profiler_capture_window_s": round(window_s, 2),
+        "profiler_capture_events": captured[0] if captured else 0,
+        "profiler_overhead_pct": round(
+            100.0 * cost_ms / 1e3 / default_interval, 3
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Phase 1d: MoE training throughput (dropless vs gshard) on hardware
 # ---------------------------------------------------------------------------
 
@@ -688,6 +770,12 @@ def main():
             result.update(decode_phase())
         except Exception as e:  # pragma: no cover
             result["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            result.update(profiler_overhead_phase())
+        except Exception as e:  # pragma: no cover
+            result["profiler_overhead_error"] = (
+                f"{type(e).__name__}: {e}"[:200]
+            )
     goodput = goodput_phase(platform)
     goodput.update(result)
     print(json.dumps(goodput))

@@ -144,6 +144,14 @@ class ElasticAgent:
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
+        # Zero-cooperation profiling: when XLA capture is enabled, the
+        # injection dir's sitecustomize arms the listener at interpreter
+        # startup even if the train script never imports this framework
+        # (reference xpu_timer's LD_PRELOAD contract). It chain-loads
+        # any sitecustomize it shadows.
+        inject_dir = os.path.join(
+            pkg_root, "dlrover_tpu", "tpu_timer", "_inject"
+        )
         for local_rank in range(spec.nproc_per_node):
             env = dict(os.environ)
             existing = env.get("PYTHONPATH", "")
@@ -152,6 +160,14 @@ class ElasticAgent:
                     f"{existing}{os.pathsep}{pkg_root}" if existing else pkg_root
                 )
             env.update(spec.env)
+            # Gate AFTER merging spec.env (the launcher may enable the
+            # flag there), with get_env_bool's truthy vocabulary.
+            if env.get("DLROVER_TPU_TIMER_XLA", "").strip().lower() in (
+                "1", "true", "yes", "on"
+            ):
+                env["PYTHONPATH"] = (
+                    f"{inject_dir}{os.pathsep}" + env["PYTHONPATH"]
+                )
             env.update(
                 worker_env(
                     coordinator=outcome.coordinator_address,

@@ -188,6 +188,12 @@ class XlaCaptureListener:
             target=self._loop, name="xla-capture", daemon=True
         )
         self._thread.start()
+        # A capture in flight while the interpreter tears down aborts
+        # the process from C++ ("FATAL: exception not rethrown" in the
+        # profiler session) — drain cleanly at exit.
+        import atexit
+
+        atexit.register(self.stop)
 
     def stop(self):
         self._stopped.set()
@@ -227,14 +233,24 @@ class XlaCaptureListener:
             self._stopped.wait(0.5)
 
 
+_started_listener: Optional[XlaCaptureListener] = None
+
+
 def maybe_start_listener(local_rank: int = 0) -> Optional[XlaCaptureListener]:
+    """Idempotent per process: an instrumented script under the agent's
+    sitecustomize injection would otherwise arm TWO listeners (startup +
+    runtime init) whose overlapping jax.profiler windows collide."""
+    global _started_listener
     from dlrover_tpu.common.env_utils import get_env_bool
 
     if not get_env_bool("DLROVER_TPU_TIMER_XLA"):
         return None
+    if _started_listener is not None:
+        return _started_listener
     interval = float(os.getenv("DLROVER_TPU_TIMER_XLA_INTERVAL", "60"))
     window = float(os.getenv("DLROVER_TPU_TIMER_XLA_WINDOW", "1.0"))
     listener = XlaCaptureListener(local_rank, interval, window)
+    _started_listener = listener
     listener.start()
     logger.info(
         "xla capture listener on (every %.0fs, %.1fs windows)",

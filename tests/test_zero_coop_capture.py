@@ -1,0 +1,100 @@
+"""Zero-cooperation profiler capture: a train script with NO
+dlrover_tpu imports still yields a capture, via the injected
+sitecustomize (reference xpu_timer's LD_PRELOAD contract)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import dlrover_tpu
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(
+    dlrover_tpu.__file__
+)))
+INJECT = os.path.join(
+    PKG_ROOT, "dlrover_tpu", "tpu_timer", "_inject"
+)
+
+SCRIPT = textwrap.dedent(
+    """
+    import jax, jax.numpy as jnp
+    import time
+    jax.config.update("jax_platforms", "cpu")
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda x: x @ x)
+    t0 = time.time()
+    while time.time() - t0 < 6.0:
+        x = f(x) * 1e-3
+    float(x.sum())
+    print("script-done")
+    """
+)
+
+
+def test_uninstrumented_script_gets_captured(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = INJECT + os.pathsep + PKG_ROOT
+    env["DLROVER_TPU_TIMER_XLA"] = "1"
+    env["DLROVER_TPU_TIMER_XLA_INTERVAL"] = "2"
+    env["DLROVER_TPU_TIMER_XLA_WINDOW"] = "0.5"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "script-done" in proc.stdout
+    err = proc.stderr
+    assert "xla capture listener on" in err, err[-2000:]
+    assert "runtime events recorded" in err, err[-2000:]
+
+
+def test_injection_off_without_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = INJECT + os.pathsep + PKG_ROOT
+    env.pop("DLROVER_TPU_TIMER_XLA", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('ok')"],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "ok" in proc.stdout
+    assert "xla capture" not in proc.stderr
+
+
+def test_shadowed_sitecustomize_is_chain_loaded(tmp_path):
+    """The inject dir shadows any platform sitecustomize (e.g. a TPU
+    plugin bootstrap) — ours must chain-load it, not swallow it."""
+    marker = tmp_path / "chained.marker"
+    (tmp_path / "sitecustomize.py").write_text(
+        f"open({str(marker)!r}, 'w').write('ran')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [INJECT, str(tmp_path), PKG_ROOT]
+    )
+    env["DLROVER_TPU_TIMER_XLA"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('ok')"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert marker.exists(), (
+        "shadowed sitecustomize never ran: " + proc.stderr[-1500:]
+    )
+    assert "xla capture listener on" in proc.stderr
+
+
+def test_listener_is_idempotent_per_process(monkeypatch):
+    from dlrover_tpu.tpu_timer import xla_capture as xc
+
+    monkeypatch.setenv("DLROVER_TPU_TIMER_XLA", "1")
+    monkeypatch.setattr(xc, "_started_listener", None)
+    l1 = xc.maybe_start_listener(0)
+    l2 = xc.maybe_start_listener(0)
+    assert l1 is not None and l1 is l2
+    l1.stop()
+    monkeypatch.setattr(xc, "_started_listener", None)
