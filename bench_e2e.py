@@ -101,9 +101,12 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
     mesh = build_mesh(MeshConfig(dp=len(jax.devices())), jax.devices())
     tc = ts.TrainConfig(warmup_steps=10)
     opt = ts.make_optimizer(tc)
-    state, specs = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
-    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=False)
+    # Restore-FIRST: a restarted incarnation goes straight from shm to
+    # device state and never compiles (or runs) the init program it
+    # would immediately overwrite — only a fresh start pays init.
+    specs = ts.state_specs(cfg, opt)
     shardings = ts.state_shardings(specs, mesh)
+    step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=False)
 
     ckpt = Checkpointer(ckpt_dir)
     restored = ckpt.load_checkpoint(sharding_tree=shardings)
@@ -112,12 +115,15 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
         jax.block_until_ready(state)
         emit("restored", step=rstep)
     else:
+        state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
         emit("fresh_start")
 
     tokens = jax.random.randint(
         jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
     ).astype(jnp.int32)
+    jax.block_until_ready(tokens)
     batch_d = {"tokens": tokens}
+    emit("data_ready")
 
     while int(state["step"]) < TOTAL_STEPS:
         t0 = time.time()
@@ -186,6 +192,12 @@ def main():
     os.environ["DLROVER_TPU_JOB_NAME"] = f"bench_e2e_{os.getpid()}"
     os.environ["DLROVER_TPU_SHARED_DIR"] = os.path.join(workdir, "uds")
     os.environ["DLROVER_TPU_NODE_RANK"] = "0"
+    # This bench measures the RECOVERY machinery, not kernels: the tiny
+    # worker model gains nothing from Pallas attention, while each
+    # Pallas kernel pays a remote Mosaic compile on restart that the
+    # persistent jit cache does not cover on tunneled dev chips —
+    # seconds of replay-warmup variance per run. Pin the XLA op.
+    os.environ.setdefault("DLROVER_TPU_ATTN", "xla")
 
     JobContext.reset_singleton()
     master = LocalJobMaster(port=0, node_num=1)
@@ -293,11 +305,30 @@ def main():
         # replay of half a save interval at clean speed; plus the
         # per-save overhead between failures.
         save_block = sum(save_blocks) / max(len(save_blocks), 1)
-        downtime = (
-            detect + init + restore + replay_warmup + SAVE_EVERY_S / 2.0
+        # The save cadence is the Young/Daly optimum from this run's OWN
+        # measured blocking cost (flash_ckpt/autotune.py), not the
+        # legacy 60s constant; both operating points are reported. The
+        # effective recovery a user experiences at the autotuned cadence
+        # is the process restart plus expected replay of half the (now
+        # short) interval.
+        from dlrover_tpu.flash_ckpt.autotune import (
+            expected_goodput_pct,
+            optimal_save_interval_s,
         )
-        overhead = (MTBF_S / SAVE_EVERY_S) * save_block
-        e2e_goodput = 100.0 * MTBF_S / (MTBF_S + overhead + downtime)
+
+        auto_every = optimal_save_interval_s(save_block, mtbf_s=MTBF_S)
+        restart_cost = detect + init + restore + replay_warmup
+
+        def goodput_at(every_s):
+            return expected_goodput_pct(
+                every_s, save_block, recovery_s=restart_cost,
+                mtbf_s=MTBF_S,
+            )
+
+        e2e_goodput = goodput_at(auto_every)
+        effective_recovery = (
+            detect + init + restore + replay_warmup + auto_every / 2.0
+        )
         result.update(
             value=round(recovery, 3),
             detect_restart_s=round(detect, 3),
@@ -306,7 +337,10 @@ def main():
             replay_s=round(replay, 3),
             replayed_steps=lost_steps,
             step_time_s=round(step_s, 4),
+            autotuned_save_every_s=round(auto_every, 2),
+            effective_recovery_s=round(effective_recovery, 3),
             e2e_goodput_pct=round(e2e_goodput, 2),
+            e2e_goodput_at_60s=round(goodput_at(SAVE_EVERY_S), 2),
             e2e_goodput_vs_baseline=round(e2e_goodput / BASELINE_GOODPUT, 4),
         )
     print(json.dumps(result), flush=True)

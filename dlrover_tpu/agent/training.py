@@ -161,10 +161,10 @@ class ElasticAgent:
                 )
             env.update(spec.env)
             # Gate AFTER merging spec.env (the launcher may enable the
-            # flag there), with get_env_bool's truthy vocabulary.
-            if env.get("DLROVER_TPU_TIMER_XLA", "").strip().lower() in (
-                "1", "true", "yes", "on"
-            ):
+            # flag there).
+            from dlrover_tpu.common.env_utils import env_bool
+
+            if env_bool(env, "DLROVER_TPU_TIMER_XLA"):
                 env["PYTHONPATH"] = (
                     f"{inject_dir}{os.pathsep}" + env["PYTHONPATH"]
                 )

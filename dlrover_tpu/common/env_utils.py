@@ -13,11 +13,20 @@ def get_env_int(name: str, default: int = 0) -> int:
         return default
 
 
-def get_env_bool(name: str, default: bool = False) -> bool:
-    value = os.getenv(name, "")
+TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_bool(mapping, name: str, default: bool = False) -> bool:
+    """Truthy test over any mapping (os.environ or a merged env dict) —
+    the ONE definition of the vocabulary; hand-rolled tuples drift."""
+    value = mapping.get(name, "")
     if not value:
         return default
-    return value.strip().lower() in ("1", "true", "yes", "on")
+    return value.strip().lower() in TRUTHY
+
+
+def get_env_bool(name: str, default: bool = False) -> bool:
+    return env_bool(os.environ, name, default)
 
 
 def get_env_str(name: str, default: str = "") -> str:

@@ -102,6 +102,9 @@ class CheckpointEngine:
         self._write_error: Optional[BaseException] = None
         self._writer_thread = None
         self._writer_stop = False
+        from dlrover_tpu.flash_ckpt.autotune import SaveCostTracker
+
+        self.cost_tracker = SaveCostTracker()
 
     # ---- save --------------------------------------------------------------
 
@@ -111,11 +114,24 @@ class CheckpointEngine:
         state: Any,
         user_meta: Optional[Dict[str, Any]] = None,
     ) -> float:
-        """Blocking-path save: device -> shm. Returns block seconds."""
-        import jax
+        """Blocking-path save: device -> shm. Returns block seconds.
 
-        from dlrover_tpu.training_event import TrainerEvents
+        For a TRAINING-THREAD caller the whole elapsed is the blocking
+        cost the Young/Daly autotuner needs, so it is recorded as such
+        here; the async writer thread must use :meth:`_save_to_memory`
+        instead — its shm write overlaps training and recording it as a
+        blocking cost would inflate the recommended cadence ~100x."""
+        elapsed = self._save_to_memory(step, state, user_meta)
+        if elapsed > 0.0:
+            self.cost_tracker.record_block(elapsed)
+        return elapsed
 
+    def _save_to_memory(
+        self,
+        step: int,
+        state: Any,
+        user_meta: Optional[Dict[str, Any]] = None,
+    ) -> float:
         start = time.time()
         with self._save_mutex:
             if step < self._last_written_step:
@@ -127,7 +143,9 @@ class CheckpointEngine:
                     self._last_written_step,
                 )
                 return 0.0
-            return self._save_to_memory_locked(step, state, user_meta, start)
+            return self._save_to_memory_locked(
+                step, state, user_meta, start
+            )
 
     def _save_to_memory_locked(self, step, state, user_meta, start):
         import jax
@@ -165,6 +183,7 @@ class CheckpointEngine:
             span.content["block_s"] = elapsed
         self._last_save_time = time.time()
         self._last_written_step = max(self._last_written_step, step)
+        self.cost_tracker.record_drain(elapsed)
         logger.info(
             "flash ckpt step %d -> shm in %.3fs", step, elapsed
         )
@@ -204,10 +223,16 @@ class CheckpointEngine:
             self._ensure_writer()
             self._snap_cond.notify_all()
         elapsed = time.time() - start
+        self.cost_tracker.record_block(elapsed)
         logger.info(
             "flash ckpt step %d async-launched in %.4fs", step, elapsed
         )
         return elapsed
+
+    def recommended_interval_s(self, mtbf_s: float = 3600.0):
+        """Young/Daly save cadence from THIS engine's measured costs
+        (flash_ckpt/autotune.py); None until a save was measured."""
+        return self.cost_tracker.recommended_interval_s(mtbf_s)
 
     def wait_async_save(self, timeout: float = 600.0) -> bool:
         """Block until every launched snapshot has landed in shm.
@@ -258,7 +283,9 @@ class CheckpointEngine:
                     continue
                 self._writing_step = step
             try:
-                self.save_to_memory(step, state, user_meta)
+                # _save_to_memory, NOT save_to_memory: this thread's shm
+                # write overlaps training — it is drain, not block.
+                self._save_to_memory(step, state, user_meta)
                 with self._snap_cond:
                     self._write_error = None
             except Exception as e:

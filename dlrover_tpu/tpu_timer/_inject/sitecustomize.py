@@ -39,12 +39,14 @@ try:
 except Exception:  # noqa: BLE001 - never break interpreter startup
     pass
 
-if os.getenv("DLROVER_TPU_TIMER_XLA", "") in ("1", "true", "on"):
-    try:
+try:
+    from dlrover_tpu.common.env_utils import get_env_bool
+
+    if get_env_bool("DLROVER_TPU_TIMER_XLA"):
         from dlrover_tpu.tpu_timer.xla_capture import maybe_start_listener
 
         maybe_start_listener(
             int(os.getenv("DLROVER_TPU_LOCAL_RANK", "0") or 0)
         )
-    except Exception:  # noqa: BLE001 - profiling must never kill a job
-        pass
+except Exception:  # noqa: BLE001 - profiling must never kill a job
+    pass

@@ -323,3 +323,62 @@ def test_keep_interval_selected_by_env(monkeypatch):
     strategy = default_deletion_strategy()
     assert isinstance(strategy, KeepStepIntervalDeletionStrategy)
     assert strategy.keep_interval == 500
+
+
+def test_autotune_interval_math():
+    from dlrover_tpu.flash_ckpt.autotune import (
+        expected_goodput_pct,
+        optimal_save_interval_s,
+    )
+
+    # ~3ms block cost at 1h MTBF -> ~4.6s cadence.
+    tau = optimal_save_interval_s(0.003, drain_s=0.5, mtbf_s=3600.0)
+    assert 4.0 < tau < 6.0, tau
+    # Costlier blocking saves push the cadence out (monotonic).
+    assert optimal_save_interval_s(0.3, 0.5, 3600.0) > tau
+    # The drain floor binds when transfers are slow.
+    assert optimal_save_interval_s(0.003, drain_s=10.0) == 20.0
+    # Bounds hold.
+    assert optimal_save_interval_s(1e-9, 0.0) >= 2.0
+    assert optimal_save_interval_s(1e9, 0.0) <= 600.0
+    # The autotuned cadence beats the old 60s constant on goodput.
+    g_auto = expected_goodput_pct(tau, 0.003, recovery_s=7.0)
+    g_60 = expected_goodput_pct(60.0, 0.003, recovery_s=7.0)
+    assert g_auto > g_60 > 95.0
+
+
+def test_engine_recommends_interval_from_measured_saves(tmp_path):
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    engine = CheckpointEngine(str(tmp_path), standalone=True)
+    try:
+        assert engine.recommended_interval_s() is None
+        state = {"w": jnp.arange(16.0)}
+        engine.save_to_memory_async(1, state)
+        assert engine.wait_async_save()
+        rec = engine.recommended_interval_s()
+        assert rec is not None and 2.0 <= rec <= 600.0
+    finally:
+        engine.close()
+
+
+def test_async_writer_does_not_pollute_block_cost(tmp_path):
+    """The writer thread's shm write is DRAIN (overlaps training); only
+    the ~ms async launch may count as blocking cost, or Young/Daly
+    recommends a ~100x sparser cadence than the engine earns."""
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    engine = CheckpointEngine(str(tmp_path), standalone=True)
+    try:
+        state = {"w": jnp.arange(1 << 16, dtype=jnp.float32)}
+        for step in (1, 2, 3):
+            engine.save_to_memory_async(step, state)
+            assert engine.wait_async_save()
+        block = engine.cost_tracker.block_s
+        drain = engine.cost_tracker.drain_s
+        assert block is not None and drain is not None
+        # launch cost must be well under the full shm write
+        assert block <= drain, (block, drain)
+        assert block < 0.05, f"async launch recorded as {block}s"
+    finally:
+        engine.close()
