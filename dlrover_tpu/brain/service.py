@@ -7,6 +7,14 @@ runtime samples and job completions; ``optimize`` answers with a worker
 count learned from completed jobs of the same job name (the cross-job
 memory a single-job local optimizer cannot have).
 
+The optimizer is PLUGGABLE (the reference's processor/evaluator plugin
+architecture, scaled down): built-ins are selected with ``--optimizer``
+(``speedup`` — best cost-adjusted throughput; ``marginal-gain`` —
+largest worker count still scaling efficiently), and external
+algorithms load from a ``pkg.module:factory`` dotted path. The JSONL
+store self-compacts (record-count and age retention) so it no longer
+grows without bound.
+
 Run: ``python -m dlrover_tpu.brain.service --port 8600 --data_dir /var/brain``
 """
 
@@ -22,12 +30,57 @@ from dlrover_tpu.common.log import logger
 
 
 class BrainStore:
-    """Append-only JSON-lines store of job samples and completions."""
+    """JSON-lines store of job samples and completions, with retention:
+    every ``compact_every`` appends (and at startup) each file is
+    rewritten keeping the newest ``max_records`` that are younger than
+    ``max_age_s`` — a brain that only ever grows eventually optimizes
+    from dead history and fills the disk."""
 
-    def __init__(self, data_dir: str):
+    def __init__(
+        self,
+        data_dir: str,
+        max_records: int = 10_000,
+        max_age_s: float = 30 * 24 * 3600.0,
+        compact_every: int = 500,
+    ):
         self._dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.Lock()
+        self._max_records = max_records
+        self._max_age_s = max_age_s
+        self._compact_every = max(compact_every, 1)
+        self._appends: Dict[str, int] = {}
+        for kind in ("runtime", "completion"):
+            self.compact(kind)
+
+    def compact(self, kind: str):
+        """Rewrite the file applying retention (atomic replace)."""
+
+        def ts_of(record) -> float:
+            # Same junk tolerance as load(): a foreign writer's bad ts
+            # must not brick service start (compact runs in __init__).
+            try:
+                return float(record.get("ts", 0))
+            except (TypeError, ValueError):
+                return 0.0
+
+        with self._lock:
+            records = self._load_unlocked(kind)
+            cutoff = time.time() - self._max_age_s
+            fresh = [r for r in records if ts_of(r) >= cutoff]
+            kept = fresh[-self._max_records:] if self._max_records > 0 else []
+            if len(kept) == len(records):
+                return
+            path = self._path(kind)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                for r in kept:
+                    f.write(json.dumps(r) + "\n")
+            os.replace(tmp, path)
+            logger.info(
+                "brain store %s compacted: %d -> %d records",
+                kind, len(records), len(kept),
+            )
 
     def _path(self, kind: str) -> str:
         return os.path.join(self._dir, f"{kind}.jsonl")
@@ -52,8 +105,16 @@ class BrainStore:
                 if needs_newline:
                     f.write("\n")
                 f.write(json.dumps(record) + "\n")
+            self._appends[kind] = self._appends.get(kind, 0) + 1
+            due = self._appends[kind] % self._compact_every == 0
+        if due:
+            self.compact(kind)
 
     def load(self, kind: str) -> List[Dict]:
+        with self._lock:
+            return self._load_unlocked(kind)
+
+    def _load_unlocked(self, kind: str) -> List[Dict]:
         records = []
         try:
             with open(self._path(kind)) as f:
@@ -72,7 +133,29 @@ class BrainStore:
         return records
 
 
-class BrainOptimizer:
+def _job_samples(store: BrainStore, job_name: str):
+    samples = []
+    for s in store.load("runtime"):
+        if s.get("job_name") != job_name:
+            continue
+        try:
+            speed = float(s.get("speed", 0))
+            count = int(s.get("worker_count", 0))
+        except (TypeError, ValueError):
+            continue  # records are caller-supplied; skip junk
+        if speed > 0 and count > 0:
+            samples.append((count, speed))
+    return samples
+
+
+def _mean_speed_by_count(samples) -> Dict[int, float]:
+    by_count: Dict[int, List[float]] = {}
+    for count, speed in samples:
+        by_count.setdefault(count, []).append(speed)
+    return {c: sum(v) / len(v) for c, v in by_count.items()}
+
+
+class SpeedupOptimizer:
     """Cross-job heuristic: among past runs of this job name, prefer the
     worker count with the best observed speed-per-worker (cost-adjusted
     throughput)."""
@@ -81,38 +164,102 @@ class BrainOptimizer:
         self._store = store
 
     def optimize(self, job_name: str) -> Optional[Dict]:
-        samples = []
-        for s in self._store.load("runtime"):
-            if s.get("job_name") != job_name:
-                continue
-            try:
-                speed = float(s.get("speed", 0))
-                count = int(s.get("worker_count", 0))
-            except (TypeError, ValueError):
-                continue  # records are caller-supplied; skip junk
-            if speed > 0 and count > 0:
-                samples.append((count, speed))
+        samples = _job_samples(self._store, job_name)
         if not samples:
             return None
-        by_count: Dict[int, List[float]] = {}
-        for count, speed in samples:
-            by_count.setdefault(count, []).append(speed)
         best_count, best_value = 0, -1.0
-        for count, speeds in by_count.items():
-            if count <= 0:
-                continue
-            value = (sum(speeds) / len(speeds)) / count
+        for count, mean in _mean_speed_by_count(samples).items():
+            value = mean / count
             if value > best_value:
                 best_count, best_value = count, value
         if best_count <= 0:
             return None
-        return {"worker_count": best_count, "evidence_samples": len(samples)}
+        return {
+            "worker_count": best_count,
+            "evidence_samples": len(samples),
+            "optimizer": "speedup",
+        }
+
+
+class MarginalGainOptimizer:
+    """Scaling-efficiency heuristic: walk observed worker counts in
+    ascending order and keep growing while each scale-up still delivered
+    at least ``efficiency`` of its proportional throughput gain —
+    answers "how far did this job USEFULLY scale", where speedup answers
+    "where was it cheapest"."""
+
+    def __init__(self, store: BrainStore, efficiency: float = 0.7):
+        self._store = store
+        self._efficiency = efficiency
+
+    def optimize(self, job_name: str) -> Optional[Dict]:
+        samples = _job_samples(self._store, job_name)
+        if not samples:
+            return None
+        means = sorted(_mean_speed_by_count(samples).items())
+        best_count = means[0][0]
+        prev_count, prev_speed = means[0]
+        for count, speed in means[1:]:
+            ideal = prev_speed * count / prev_count
+            if speed >= self._efficiency * ideal:
+                best_count = count
+                prev_count, prev_speed = count, speed
+            else:
+                break
+        return {
+            "worker_count": best_count,
+            "evidence_samples": len(samples),
+            "optimizer": "marginal-gain",
+        }
+
+
+# Back-compat alias: the original single algorithm.
+BrainOptimizer = SpeedupOptimizer
+
+OPTIMIZERS = {
+    "speedup": SpeedupOptimizer,
+    "marginal-gain": MarginalGainOptimizer,
+}
+
+
+def create_optimizer(name: str, store: BrainStore):
+    """Resolve an optimizer: a registry name or an external
+    ``pkg.module:factory`` dotted path (the plugin contract — factory
+    takes the store, returns an object with ``optimize(job_name)``)."""
+    if name in OPTIMIZERS:
+        return OPTIMIZERS[name](store)
+    if ":" in name:
+        import importlib
+
+        module, attr = name.split(":", 1)
+        try:
+            factory = getattr(importlib.import_module(module), attr)
+        except (ImportError, AttributeError, ValueError) as e:
+            raise ValueError(
+                f"optimizer plugin {name!r} failed to load ({e}); "
+                f"expected pkg.module:factory, or a registry name from "
+                f"{sorted(OPTIMIZERS)}"
+            ) from e
+        return factory(store)
+    raise ValueError(
+        f"unknown optimizer {name!r}; registry: {sorted(OPTIMIZERS)} "
+        f"or a pkg.module:factory path"
+    )
 
 
 class BrainService:
-    def __init__(self, port: int = 0, data_dir: str = "/tmp/dlrover_brain"):
-        self.store = BrainStore(data_dir)
-        self.optimizer = BrainOptimizer(self.store)
+    def __init__(
+        self,
+        port: int = 0,
+        data_dir: str = "/tmp/dlrover_brain",
+        optimizer: str = "speedup",
+        max_records: int = 10_000,
+        max_age_s: float = 30 * 24 * 3600.0,
+    ):
+        self.store = BrainStore(
+            data_dir, max_records=max_records, max_age_s=max_age_s
+        )
+        self.optimizer = create_optimizer(optimizer, self.store)
         self._server = ThreadingHTTPServer(
             ("0.0.0.0", port), self._make_handler()
         )
@@ -175,8 +322,24 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="dlrover-tpu brain")
     parser.add_argument("--port", type=int, default=8600)
     parser.add_argument("--data_dir", type=str, default="/tmp/dlrover_brain")
+    parser.add_argument(
+        "--optimizer", type=str,
+        default=os.getenv("DLROVER_TPU_BRAIN_OPTIMIZER", "speedup"),
+        help="registry name (speedup, marginal-gain) or pkg.module:factory",
+    )
+    parser.add_argument("--max_records", type=int, default=10_000)
+    parser.add_argument(
+        "--max_age_days", type=float, default=30.0,
+        help="retention window for the JSONL store",
+    )
     args = parser.parse_args(argv)
-    service = BrainService(args.port, args.data_dir)
+    service = BrainService(
+        args.port,
+        args.data_dir,
+        optimizer=args.optimizer,
+        max_records=args.max_records,
+        max_age_s=args.max_age_days * 24 * 3600.0,
+    )
     service.start()
     try:
         while True:

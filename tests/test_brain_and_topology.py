@@ -142,3 +142,57 @@ def test_topology_order_flows_to_agents():
     _, _, world = mgr.get_comm_world(0)
     # Slice-mates adjacent: 0,2 (block .1) then 1,3 (block .2).
     assert list(world) == [0, 2, 1, 3]
+
+
+def test_brain_optimizer_registry_and_marginal_gain(tmp_path):
+    from dlrover_tpu.brain.service import (
+        BrainStore,
+        create_optimizer,
+    )
+
+    store = BrainStore(str(tmp_path))
+    # Scaling curve: 4 workers ~4k, 8 workers ~7k (88% efficient),
+    # 16 workers ~8k (57% efficient — stops here).
+    for count, speed in ((4, 4000), (8, 7000), (16, 8000)):
+        store.append(
+            "runtime",
+            {"job_name": "j", "worker_count": count, "speed": speed},
+        )
+    mg = create_optimizer("marginal-gain", store)
+    plan = mg.optimize("j")
+    assert plan["worker_count"] == 8, plan
+    sp = create_optimizer("speedup", store)
+    assert sp.optimize("j")["worker_count"] == 4  # best speed/worker
+    # External plugin path + unknown name.
+    ext = create_optimizer(
+        "dlrover_tpu.brain.service:MarginalGainOptimizer", store
+    )
+    assert ext.optimize("j")["worker_count"] == 8
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown optimizer"):
+        create_optimizer("nope", store)
+
+
+def test_brain_store_retention(tmp_path):
+    import json as _json
+    import time as _time
+
+    from dlrover_tpu.brain.service import BrainStore
+
+    store = BrainStore(str(tmp_path), max_records=5, compact_every=3)
+    for i in range(9):
+        store.append("runtime", {"job_name": "j", "i": i})
+    records = store.load("runtime")
+    assert len(records) <= 6  # compaction kicked in at the cadence
+    assert records[-1]["i"] == 8
+    # Age-based retention drops dead history at startup.
+    path = tmp_path / "runtime.jsonl"
+    old = [{"job_name": "j", "i": -1, "ts": _time.time() - 10 * 24 * 3600}]
+    path.write_text(
+        "\n".join(_json.dumps(r) for r in old) + "\n"
+    )
+    store2 = BrainStore(
+        str(tmp_path), max_records=5, max_age_s=24 * 3600.0
+    )
+    assert store2.load("runtime") == []
