@@ -747,11 +747,29 @@ def e2e_phase():
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py"
     )
-    proc = subprocess.run(
-        [sys.executable, path], capture_output=True, text=True, timeout=900
-    )
-    line = proc.stdout.strip().splitlines()[-1]
-    d = json.loads(line)
+    # File redirection, NOT pipes: the e2e job's detached grandchildren
+    # (agent workers, multiprocessing resource trackers) inherit stdio
+    # and can outlive the child — a captured pipe then never reaches
+    # EOF and subprocess.run hangs long after the benchmark finished.
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out_f, tempfile.TemporaryFile(
+        "w+"
+    ) as err_f:
+        proc = subprocess.run(
+            [sys.executable, path], stdout=out_f, stderr=err_f,
+            timeout=900,
+        )
+        out_f.seek(0)
+        lines = out_f.read().strip().splitlines()
+        if not lines:
+            err_f.seek(0)
+            tail = err_f.read()[-2000:]
+            raise RuntimeError(
+                f"bench_e2e produced no output "
+                f"(rc={proc.returncode}); stderr tail: {tail}"
+            )
+    d = json.loads(lines[-1])
     out = {"measured_recovery_s": d.get("value")}
     for key in (
         "detect_restart_s",

@@ -68,7 +68,14 @@ class BrainStore:
             records = self._load_unlocked(kind)
             cutoff = time.time() - self._max_age_s
             fresh = [r for r in records if ts_of(r) >= cutoff]
-            kept = fresh[-self._max_records:] if self._max_records > 0 else []
+            # max_records <= 0 means NO count cap (age still applies) —
+            # the naive [-0:] slice would keep everything, while [] here
+            # would irreversibly wipe the store at startup.
+            kept = (
+                fresh[-self._max_records:]
+                if self._max_records > 0
+                else fresh
+            )
             if len(kept) == len(records):
                 return
             path = self._path(kind)

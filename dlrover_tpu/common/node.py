@@ -115,6 +115,9 @@ class Node:
         self.migrated = False
         self.paral_config_version = -1
         self.reported_status: str = ""
+        # (ts, status) transitions — the dashboard's node-detail
+        # timeline; bounded so a crash-looping node can't grow it.
+        self.status_history: list = [(time.time(), status)]
 
     # ---- status transitions -------------------------------------------------
 
@@ -131,6 +134,8 @@ class Node:
             if status in NodeStatus.end_states():
                 self.finish_time = time.time()
             self.status = status
+            self.status_history.append((time.time(), status))
+            del self.status_history[:-50]
         return allowed
 
     def is_end(self) -> bool:
@@ -197,6 +202,10 @@ class Node:
         # The lineage's exit history rides along (shared list: past
         # exits are immutable facts about the rank, not the pod).
         new_node.exit_history = self.exit_history
+        # Fresh timeline: the POD's life starts now (copy.copy would
+        # share the predecessor's list — appends from either object
+        # would cross-pollute both dashboards' timelines).
+        new_node.status_history = [(time.time(), NodeStatus.INITIAL)]
         new_node.used_resource = NodeResource()
         new_node.heartbeat_time = 0
         return new_node

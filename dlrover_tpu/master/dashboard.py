@@ -45,8 +45,11 @@ function fill(t, rows){
  while(t.rows.length > 1) t.deleteRow(1);
  for(const cells of rows){
   const r = t.insertRow();
-  for(const [v, cls] of cells){
-   const c = r.insertCell(); c.textContent = v;
+  for(const [v, cls, href] of cells){
+   const c = r.insertCell();
+   if(href){const a=document.createElement('a');a.href=href;
+    a.textContent=v;c.appendChild(a);}
+   else c.textContent = v;
    if(cls) c.className = cls;
   }
  }
@@ -63,7 +66,8 @@ async function refresh(){
  document.getElementById('speed').textContent = perf.speed.toFixed(2);
  document.getElementById('goodput').textContent = (perf.goodput*100).toFixed(1);
  fill(document.getElementById('nodes'), nodes.map(n => [
-  [n.id], [n.type], [n.rank], [n.node_group < 0 ? '-' : n.node_group],
+  [n.type + '-' + n.id, '', '/node/' + n.type + '-' + n.id],
+  [n.type], [n.rank], [n.node_group < 0 ? '-' : n.node_group],
   [n.status, n.status], [n.relaunch_count],
   [n.exit_history.join(',') || '-'],
   [n.heartbeat_age_s == null ? '-' : n.heartbeat_age_s + 's'],
@@ -72,6 +76,75 @@ async function refresh(){
   [r.name], [r.round], [r.waiting], [r.world_size]]));
  fill(document.getElementById('data'), data.map(d => [
   [d.name], [d.todo], [d.doing], [d.completed], [d.records_done]]));
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+_NODE_PAGE = """<!DOCTYPE html>
+<html><head><title>dlrover-tpu node</title>
+<style>
+body{font-family:monospace;margin:2em;background:#fafafa}
+table{border-collapse:collapse;margin-bottom:1.2em}
+td,th{border:1px solid #999;padding:4px 10px}
+h1{font-size:1.3em}h2{font-size:1.05em;margin-bottom:.3em}
+.Running{color:green}.Failed,.Breakdown{color:red}
+.Pending,.Initial{color:#b8860b}.Succeeded{color:blue}
+</style></head><body>
+<p><a href="/">&larr; job</a></p>
+<h1>node <span id="name"></span></h1>
+<h2>facts</h2>
+<table id="facts"><tr><th>field</th><th>value</th></tr></table>
+<h2>status timeline</h2>
+<table id="tl"><tr><th>time</th><th>status</th><th>+s</th></tr></table>
+<h2>exit history</h2>
+<table id="exits"><tr><th>#</th><th>reason</th></tr></table>
+<script>
+async function refresh(){
+ const key = location.pathname.split('/').pop();
+ const resp = await fetch('/api/node/' + key);
+ if(!resp.ok){document.getElementById('name').textContent =
+   key + ' (not found)'; return;}
+ const n = await resp.json();
+ document.getElementById('name').textContent = n.name;
+ const facts = document.getElementById('facts');
+ while(facts.rows.length > 1) facts.deleteRow(1);
+ const rows = [['type', n.type], ['rank', n.rank],
+  ['slice block', n.node_group < 0 ? '-' : n.node_group],
+  ['status', n.status], ['reported status', n.reported_status || '-'],
+  ['host', (n.host || '-') + (n.host_ip ? ' (' + n.host_ip + ')' : '')],
+  ['critical', n.critical], ['relaunches',
+   n.relaunch_count + ' / ' + n.max_relaunch_count],
+  ['relaunchable', n.relaunchable],
+  ['unrecoverable', n.unrecoverable || '-'],
+  ['exit reason', n.exit_reason || '-'],
+  ['heartbeat age', n.heartbeat_age_s == null ? '-'
+    : n.heartbeat_age_s + 's'],
+  ['resources', 'cpu ' + n.resource.cpu + ', mem ' +
+   n.resource.memory_mb + 'MB, chips ' + n.resource.tpu_chips]];
+ for(const [k, v] of rows){
+  const r = facts.insertRow();
+  r.insertCell().textContent = k;
+  const c = r.insertCell(); c.textContent = v;
+  if(k == 'status') c.className = n.status;
+ }
+ const tl = document.getElementById('tl');
+ while(tl.rows.length > 1) tl.deleteRow(1);
+ const t0 = n.timeline.length ? n.timeline[0].ts : 0;
+ for(const ev of n.timeline){
+  const r = tl.insertRow();
+  r.insertCell().textContent = new Date(ev.ts*1000).toISOString();
+  const c = r.insertCell(); c.textContent = ev.status;
+  c.className = ev.status;
+  r.insertCell().textContent = (ev.ts - t0).toFixed(1);
+ }
+ const ex = document.getElementById('exits');
+ while(ex.rows.length > 1) ex.deleteRow(1);
+ n.exit_history.forEach((reason, i) => {
+  const r = ex.insertRow();
+  r.insertCell().textContent = i + 1;
+  r.insertCell().textContent = reason;
+ });
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
@@ -132,6 +205,18 @@ class DashboardServer:
                         json.dumps(dashboard._datasets()),
                         "application/json",
                     )
+                elif self.path.startswith("/api/node/"):
+                    detail = dashboard._node_detail(
+                        self.path.rsplit("/", 1)[-1]
+                    )
+                    if detail is None:
+                        self._send(404, "no such node", "text/plain")
+                    else:
+                        self._send(
+                            200, json.dumps(detail), "application/json"
+                        )
+                elif self.path.startswith("/node/"):
+                    self._send(200, _NODE_PAGE, "text/html")
                 else:
                     self._send(404, "not found", "text/plain")
 
@@ -161,15 +246,7 @@ class DashboardServer:
         }
 
     def _nodes(self):
-        managers = getattr(self._job_manager, "role_managers", None)
-        if managers is None:
-            worker = getattr(self._job_manager, "worker_manager", None)
-            if worker is None:
-                return []
-            managers = {"worker": worker}
-        all_nodes = []
-        for manager in managers.values():
-            all_nodes.extend(manager.nodes.values())
+        all_nodes = self._all_nodes()
         now = time.time()
         rows = []
         for node in sorted(
@@ -194,6 +271,68 @@ class DashboardServer:
                 }
             )
         return rows
+
+    def _all_nodes(self):
+        managers = getattr(self._job_manager, "role_managers", None)
+        if managers is None:
+            worker = getattr(self._job_manager, "worker_manager", None)
+            if worker is None:
+                return []
+            managers = {"worker": worker}
+        all_nodes = []
+        for manager in managers.values():
+            all_nodes.extend(manager.nodes.values())
+        return all_nodes
+
+    def _node_detail(self, key: str):
+        """Everything the master knows about one node ("type-id" key or
+        bare id) — the drill-down an SRE reads during an incident
+        (reference dashboard node_detail.html)."""
+        for node in self._all_nodes():
+            # Unambiguous keys only: a bare numeric id collides across
+            # roles in multi-role jobs (actor-3 vs rollout-3).
+            if key in (f"{node.type}-{node.id}", node.name):
+                now = time.time()
+                return {
+                    "id": node.id,
+                    "name": node.name,
+                    "type": node.type,
+                    "rank": node.rank_index,
+                    "node_group": node.node_group,
+                    "status": node.status,
+                    "reported_status": node.reported_status,
+                    "host": node.host_name,
+                    "host_ip": node.host_ip,
+                    "critical": node.critical,
+                    "relaunch_count": node.relaunch_count,
+                    "max_relaunch_count": node.max_relaunch_count,
+                    "relaunchable": node.relaunchable,
+                    "exit_reason": node.exit_reason,
+                    "exit_history": list(node.exit_history),
+                    "unrecoverable": node.is_unrecoverable_failure(),
+                    "heartbeat_age_s": (
+                        round(now - node.heartbeat_time)
+                        if node.heartbeat_time > 0
+                        else None
+                    ),
+                    "create_time": node.create_time,
+                    "start_time": node.start_time,
+                    "finish_time": node.finish_time,
+                    "timeline": [
+                        {"ts": ts, "status": status}
+                        for ts, status in getattr(
+                            node, "status_history", []
+                        )
+                    ],
+                    "resource": {
+                        "cpu": node.config_resource.cpu,
+                        "memory_mb": node.config_resource.memory_mb,
+                        "tpu_chips": node.config_resource.tpu_chips,
+                        "used_cpu": node.used_resource.cpu,
+                        "used_memory_mb": node.used_resource.memory_mb,
+                    },
+                }
+        return None
 
     def _rdzv(self):
         rows = []

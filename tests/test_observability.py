@@ -204,3 +204,70 @@ def test_dashboard_new_apis():
         assert data_rows[0]["doing"] == 1
     finally:
         dash.stop()
+
+
+def test_dashboard_node_detail():
+    """Node drill-down: /api/node/<key> serves full facts + the status
+    timeline; /node/<key> serves the detail page."""
+    import urllib.request
+
+    from dlrover_tpu.common.node import Node, NodeStatus
+    from dlrover_tpu.master.dashboard import DashboardServer
+
+    class FakeManager:
+        def __init__(self, nodes):
+            self.nodes = nodes
+
+    node = Node(node_id=3, rank_index=1, host_name="host-a")
+    node.update_status(NodeStatus.PENDING)
+    node.update_status(NodeStatus.RUNNING)
+    node.exit_history.append("preempted")
+    node.node_group = 2
+
+    class FakeJobManager:
+        role_managers = {"worker": FakeManager({3: node})}
+
+        def get_job_detail(self):
+            raise NotImplementedError
+
+    class FakePerf:
+        global_step = 0
+
+        def running_speed(self):
+            return 0.0
+
+        def goodput(self):
+            return 1.0
+
+    dash = DashboardServer(FakeJobManager(), FakePerf(), port=0)
+    dash.start()
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        detail = json.loads(
+            urllib.request.urlopen(
+                base + "/api/node/worker-3", timeout=10
+            ).read()
+        )
+        assert detail["rank"] == 1
+        assert detail["node_group"] == 2
+        assert detail["status"] == NodeStatus.RUNNING
+        assert detail["exit_history"] == ["preempted"]
+        statuses = [ev["status"] for ev in detail["timeline"]]
+        assert statuses[-2:] == [NodeStatus.PENDING, NodeStatus.RUNNING]
+        page = urllib.request.urlopen(
+            base + "/node/worker-3", timeout=10
+        ).read().decode()
+        assert "status timeline" in page
+        assert (
+            urllib.request.urlopen(base + "/api/nodes", timeout=10)
+            .getcode() == 200
+        )
+        import urllib.error
+
+        try:
+            urllib.request.urlopen(base + "/api/node/ghost", timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        dash.stop()
