@@ -313,22 +313,29 @@ def _pallas_backward(
     logz_l = _lane(logz, jnp.float32)
     a_l = _lane(coef_a, jnp.float32)
     b_l = _lane(coef_b, jnp.float32)
-    nv = vp // block_v
 
+    # Mosaic's scoped-VMEM budget tightens slightly at very large row
+    # counts (measured: the 1024-wide vocab block fits at n<=32k and
+    # overflows by ~170KB at n=64k) — halve the block there.
+    bv_dx = block_v if n <= 32768 else min(block_v, 512)
+    vp_dx = _ceil_to(v, bv_dx)
+    wp_dx = wp[:, :vp_dx] if vp_dx <= wp.shape[1] else jnp.pad(
+        w, ((0, 0), (0, vp_dx - v))
+    ).astype(x.dtype)
     stat = pl.BlockSpec((bn, LANES), lambda i, j: (i, 0))
     dx = pl.pallas_call(
-        functools.partial(_bwd_dx_kernel, v=v, block_v=block_v),
+        functools.partial(_bwd_dx_kernel, v=v, block_v=bv_dx),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
-        grid=(n // bn, nv),
+        grid=(n // bn, vp_dx // bv_dx),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((d, bv_dx), lambda i, j: (0, j)),
             stat, stat, stat, stat,
         ],
         out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
         interpret=interpret,
-    )(x, wp, tgt_l, logz_l, a_l, b_l)
+    )(x, wp_dx, tgt_l, logz_l, a_l, b_l)
 
     # The dw kernel holds a [d, block_v] f32 accumulator on top of the
     # streamed tiles — at d=1024, block_v=1024 that exceeds the 16 MB
