@@ -94,6 +94,16 @@ class TpuLMConfig:
         """Approximate training FLOPs per token (fwd+bwd ~= 6 * params)."""
         return 6.0 * self.count_params()
 
+    def attention_flops_per_token(self, seq: int, causal: bool = True):
+        """Training attention-matmul FLOPs per token at sequence ``seq``:
+        3 (fwd + bwd) x 2 matmuls (QK^T, AV) x 2 FLOPs/MAC x seq x
+        n_heads x head_dim per layer, halved for causal masking. Excluded
+        from the 6N model-FLOPs basis; at long context they dominate, so
+        honest MFU there is (6N + attention) — the basis the longctx
+        bench reports."""
+        f = 12.0 * self.n_layers * self.n_heads * self.head_dim * seq
+        return f / 2 if causal else f
+
     def count_params(self) -> int:
         d, hd = self.embed_dim, self.head_dim
         attn = d * (self.n_heads + 2 * self.n_kv_heads) * hd
@@ -605,11 +615,12 @@ def loss_fn(config, params, batch, attention_fn=None):
     """
     tokens = batch["tokens"][:, :-1]
     targets = batch["tokens"][:, 1:]
-    # Fused CE is a MEMORY lever, not a time one: on v5e the dense CE at
-    # the flagship shape is already compute-bound (measured 19ms dense vs
-    # 29ms fused — the flash-style recompute costs 5 matmul passes vs 3),
-    # so "auto" only engages it when the f32 logits would be prohibitive
-    # (> ~4GB, e.g. long-context SFT where dense simply OOMs).
+    # The chunked fused CE runs at ~1.01-1.07x dense on v5e (same three
+    # matmuls; gradients computed in the forward, see ops/fused_ce.py)
+    # while never materializing the [N, V] logits. "auto" engages it when
+    # the f32 logits would be prohibitive (> ~4GB, e.g. long-context SFT
+    # where dense simply OOMs); below that, dense keeps its measured edge
+    # on the flagship MFU path.
     mode = _fused_ce_mode()
     logits_bytes = tokens.size * config.vocab_size * 4
     use_fused = mode == "on" or (

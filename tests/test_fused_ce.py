@@ -29,7 +29,7 @@ def _rand(key, b=2, s=12, d=32, v=300):
     return x, w, targets, mask
 
 
-@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("impl", ["xla", "pallas", "chunked"])
 @pytest.mark.parametrize("mask_on", [False, True])
 def test_loss_and_grads_match_dense(impl, mask_on):
     x, w, targets, mask = _rand(jax.random.key(0))
@@ -41,7 +41,8 @@ def test_loss_and_grads_match_dense(impl, mask_on):
 
     def fused(x, w):
         return fused_cross_entropy(
-            x, w, targets, mask, block_n=8, block_v=128, impl=impl
+            x, w, targets, mask, block_n=8, block_v=128, block_rows=8,
+            impl=impl,
         )
 
     loss, (dx, dw) = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
@@ -51,22 +52,23 @@ def test_loss_and_grads_match_dense(impl, mask_on):
     np.testing.assert_allclose(dw, ref_dw, rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("impl", ["xla", "pallas", "chunked"])
 def test_ragged_vocab_and_tokens(impl):
     # v=300 is not a multiple of block_v=128 (pad block) and b*s=21 is
     # not a multiple of 8 (pad rows) — both must be invisible.
     x, w, targets, _ = _rand(jax.random.key(1), b=3, s=7, d=16, v=300)
     ref = _dense_loss(x, w, targets)
     got = fused_cross_entropy(
-        x, w, targets, block_n=8, block_v=128, impl=impl
+        x, w, targets, block_n=8, block_v=128, block_rows=8, impl=impl
     )
     np.testing.assert_allclose(got, ref, rtol=1e-5)
 
 
-def test_zero_mask_is_finite():
+@pytest.mark.parametrize("impl", ["xla", "chunked"])
+def test_zero_mask_is_finite(impl):
     x, w, targets, _ = _rand(jax.random.key(2))
     mask = jnp.zeros(targets.shape, jnp.int32)
-    loss = fused_cross_entropy(x, w, targets, mask, impl="xla")
+    loss = fused_cross_entropy(x, w, targets, mask, impl=impl)
     assert bool(jnp.isfinite(loss))
     assert float(loss) == 0.0
 
