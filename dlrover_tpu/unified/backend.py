@@ -41,6 +41,11 @@ class UnifiedEnv:
     # Which backend launched this worker — the runtime data plane
     # (unified/rpc.py) picks its registry implementation from it.
     BACKEND = "DLROVER_TPU_UNIFIED_BACKEND"
+    # Per-job shared secret for the runtime data plane (unified/rpc.py):
+    # the manager resolves/creates it once and injects it into every
+    # worker so auth works cross-node (Ray) without a shared filesystem.
+    # Aliased from rpc.py (which reads it) so the two can't drift.
+    from dlrover_tpu.unified.rpc import RUNTIME_TOKEN_ENV as RUNTIME_TOKEN
 
 
 @dataclass
@@ -81,6 +86,8 @@ def worker_cmd(role: RoleConfig) -> list:
 def worker_envs(
     vertex: Vertex, job_name: str, backend: str = "local"
 ) -> Dict[str, str]:
+    from dlrover_tpu.unified.rpc import resolve_runtime_token
+
     return {
         UnifiedEnv.ROLE: vertex.role,
         UnifiedEnv.ROLE_RANK: str(vertex.rank),
@@ -90,6 +97,7 @@ def worker_envs(
         UnifiedEnv.NODE_SLOT: str(vertex.node_slot),
         UnifiedEnv.JOB_NAME: job_name,
         UnifiedEnv.BACKEND: backend,
+        UnifiedEnv.RUNTIME_TOKEN: resolve_runtime_token(job_name),
     }
 
 

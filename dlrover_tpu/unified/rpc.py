@@ -21,13 +21,27 @@ worker can open, so the SAME API works on both backends:
 
 Payloads are pickled — numpy arrays (and anything picklable) ship
 as-is; device arrays should be pulled to host first (np.asarray).
+
+**Trust boundary**: pickle executes code on load, so every connection
+must prove job membership BEFORE its first frame is parsed. The client
+sends a fixed-length preamble (magic + sha256 of the per-job secret)
+immediately after connect; the server reads exactly that many bytes,
+compares in constant time, and drops the connection on mismatch —
+nothing attacker-controlled ever reaches ``pickle.loads``. The secret
+is the manager-injected DLROVER_TPU_RUNTIME_TOKEN env (the manager
+generates one per job, unified/backend.worker_envs), falling back to a
+0600 token file in the job runtime dir for same-host/standalone use —
+the same bearer-secret scheme as flash_ckpt/replica.py.
 """
 
+import hashlib
+import hmac
 import io
 import json
 import os
 import pickle
 import queue as queue_mod
+import secrets
 import socket
 import socketserver
 import tempfile
@@ -39,11 +53,93 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import logger
 
-_MAX_MSG = 1 << 31
+# Per-frame cap: big enough for rollout tensor batches, small enough
+# that a garbage length prefix cannot OOM the worker. Override with
+# DLROVER_TPU_RUNTIME_MAX_MSG (bytes) for jobs shipping larger blobs.
+_MAX_MSG = int(os.getenv("DLROVER_TPU_RUNTIME_MAX_MSG", str(256 << 20)))
+
+RUNTIME_TOKEN_ENV = "DLROVER_TPU_RUNTIME_TOKEN"
+_AUTH_MAGIC = b"DTRT1"
+_AUTH_LEN = len(_AUTH_MAGIC) + hashlib.sha256().digest_size
+
+
+def _require_private(path: str, what: str):
+    """Refuse to trust a token dir/file another uid owns (or that other
+    uids can read/replace) — a hostile local user pre-planting the
+    predictable tmp path would otherwise hold the job secret (and with
+    it the pickle endpoint). Squatting turns into a loud failure, not
+    silent secret sharing. Files must be unreadable by others (they
+    hold the secret); for the dir only foreign WRITE access matters
+    (registry JSON lives there too and may be world-readable)."""
+    st = os.stat(path)
+    bad_bits = 0o022 if what == "dir" else 0o077
+    if st.st_uid != os.getuid() or (st.st_mode & bad_bits):
+        raise RuntimeError(
+            f"runtime token {what} {path} is not private to uid "
+            f"{os.getuid()} (owner {st.st_uid}, mode "
+            f"{oct(st.st_mode & 0o777)}) — refusing to use it; remove "
+            f"it or set {RUNTIME_TOKEN_ENV}"
+        )
+
+
+def resolve_runtime_token(job_name: str, create: bool = True) -> str:
+    """Per-job shared secret for the runtime data plane.
+
+    Order: operator/manager-injected env (works cross-node under Ray),
+    then a 0600 owner-checked token file in the job runtime dir
+    (same-host processes; atomically created by whoever gets there
+    first). The env token only applies to this process's OWN job — a
+    caller explicitly naming a different job (cross-job clients) gets
+    that job's file token, not ours. ``create=False`` raises instead of
+    minting a file token."""
+    token = os.getenv(RUNTIME_TOKEN_ENV, "")
+    if token:
+        from dlrover_tpu.unified.backend import UnifiedEnv
+
+        own_job = os.getenv(UnifiedEnv.JOB_NAME, job_name)
+        if not job_name or job_name == own_job:
+            return token
+    path = os.path.join(runtime_dir(job_name), "token")
+    for _ in range(100):
+        try:
+            with open(path) as f:
+                token = f.read().strip()
+            if token:
+                _require_private(path, "file")
+                return token
+            time.sleep(0.01)  # creator mid-write (link happens after
+            continue          # the write, so this is near-impossible)
+        except OSError:
+            break
+    if not create:
+        raise RuntimeError(
+            f"no runtime token: set {RUNTIME_TOKEN_ENV} or start the "
+            "job through a unified manager"
+        )
+    os.makedirs(runtime_dir(job_name), mode=0o700, exist_ok=True)
+    _require_private(runtime_dir(job_name), "dir")
+    token = secrets.token_hex(16)
+    tmp = path + f".tmp{os.getpid()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(token)
+    try:
+        os.link(tmp, path)  # atomic publish: first creator wins
+    except FileExistsError:
+        with open(path) as f:
+            token = f.read().strip()
+        _require_private(path, "file")
+    finally:
+        os.unlink(tmp)
+    return token
+
+
+def _token_digest(token: str) -> bytes:
+    return hashlib.sha256(token.encode()).digest()
 
 
 # ---------------------------------------------------------------------------
-# Wire protocol: 8-byte big-endian length + pickle
+# Wire protocol: auth preamble on connect, then 8-byte length + pickle
 # ---------------------------------------------------------------------------
 
 
@@ -51,7 +147,25 @@ def _send(sock: socket.socket, obj: Any):
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     data = buf.getvalue()
+    if len(data) > _MAX_MSG:
+        # Enforced before any byte hits the wire so the peer never sees
+        # a half-frame; the receiver enforces the same cap on garbage
+        # length prefixes.
+        raise _FrameTooLarge(len(data))
     sock.sendall(len(data).to_bytes(8, "big") + data)
+
+
+class _FrameTooLarge(ValueError):
+    """Oversized frame; carries the claimed size so the server can
+    drain the body before replying with the reason."""
+
+    def __init__(self, size: int):
+        super().__init__(
+            f"frame of {size} bytes exceeds the {_MAX_MSG}-byte cap — "
+            "raise DLROVER_TPU_RUNTIME_MAX_MSG on both ends for jobs "
+            "shipping larger payloads"
+        )
+        self.size = size
 
 
 def _recv(sock: socket.socket) -> Any:
@@ -63,7 +177,7 @@ def _recv(sock: socket.socket) -> Any:
         hdr += chunk
     size = int.from_bytes(hdr, "big")
     if size > _MAX_MSG:
-        raise ValueError(f"message too large: {size}")
+        raise _FrameTooLarge(size)
     parts, got = [], 0
     while got < size:
         chunk = sock.recv(min(1 << 20, size - got))
@@ -82,29 +196,67 @@ def _recv(sock: socket.socket) -> Any:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         endpoint: "WorkerEndpoint" = self.server.endpoint  # type: ignore
+        if not endpoint.authenticate(self.request):
+            # No frame was parsed; close without a reply so the peer
+            # learns nothing (parity with replica.py's 403-before-body).
+            return
         endpoint.track(self.request)
         try:
             while True:
-                req = _recv(self.request)
+                try:
+                    req = _recv(self.request)
+                except _FrameTooLarge as e:
+                    # Oversized request: drain the in-flight body first
+                    # (otherwise the sender is still mid-sendall and
+                    # sees a reset instead of our reply), then reply
+                    # with the reason and drop the connection.
+                    self._drain(e.size)
+                    try:
+                        _send(self.request, {"ok": False,
+                                             "error": str(e)})
+                    except OSError:
+                        pass
+                    break
                 rsp = endpoint.dispatch(req)
                 try:
                     # _send serializes fully before any byte hits the
-                    # wire, so a pickling failure leaves the stream
-                    # clean — report it instead of killing the
-                    # connection (which would push the client into its
-                    # reconnect-and-re-execute path).
+                    # wire, so a pickling failure (or an over-cap
+                    # reply) leaves the stream clean — report it
+                    # instead of killing the connection (which would
+                    # push the client into its reconnect-and-re-execute
+                    # path; for non-idempotent methods or queue gets
+                    # that means double execution / lost items).
                     _send(self.request, rsp)
                 except (pickle.PicklingError, TypeError,
-                        AttributeError) as e:
+                        AttributeError, ValueError) as e:
                     _send(self.request, {
                         "ok": False,
-                        "error": f"unpicklable reply: "
+                        "error": f"unsendable reply: "
                                  f"{type(e).__name__}: {e}",
                     })
         except (ConnectionError, OSError):
             pass
         finally:
             endpoint.untrack(self.request)
+
+    def _drain(self, size: int):
+        """Discard the in-flight body bytes so the sender's sendall
+        completes and our error reply lands (instead of a reset). Time-
+        bounded: a legit cap-mismatched frame drains at wire speed in
+        seconds, while a hostile length prefix trickled slowly cannot
+        pin this thread past the deadline."""
+        left = size
+        deadline = time.time() + 30.0
+        try:
+            while left > 0 and time.time() < deadline:
+                self.request.settimeout(10.0)
+                chunk = self.request.recv(min(1 << 20, left))
+                if not chunk:
+                    return
+                left -= len(chunk)
+            self.request.settimeout(None)
+        except OSError:
+            pass
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -117,10 +269,24 @@ class WorkerEndpoint:
     queues over TCP."""
 
     def __init__(self, host: str = "127.0.0.1",
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 token: Optional[str] = None,
+                 job_name: Optional[str] = None):
         """``host`` is the bind address; ``advertise_host`` (default:
         host) is what goes into the registry — bind 0.0.0.0 and
-        advertise the node IP for cross-node (Ray) jobs."""
+        advertise the node IP for cross-node (Ray) jobs. ``token`` is
+        the job secret every connection must present (default: resolved
+        from env/token-file for ``job_name``, itself defaulting to this
+        process's job env — pass one or the other when constructing an
+        endpoint for a job you are not a worker of, or clients
+        resolving the token for that job will never match)."""
+        if token is None:
+            if job_name is None:
+                from dlrover_tpu.unified.backend import UnifiedEnv
+
+                job_name = os.getenv(UnifiedEnv.JOB_NAME, "")
+            token = resolve_runtime_token(job_name)
+        self._digest = _token_digest(token)
         self._methods: Dict[str, Callable] = {}
         self._queues: Dict[str, queue_mod.Queue] = {}
         self._lock = threading.Lock()
@@ -188,6 +354,35 @@ class WorkerEndpoint:
                 "error": f"{type(e).__name__}: {e}",
                 "traceback": traceback.format_exc(),
             }
+
+    def authenticate(self, sock: socket.socket) -> bool:
+        """Read the fixed-length preamble and verify the job secret —
+        BEFORE any pickle byte is parsed. False closes the connection."""
+        try:
+            sock.settimeout(10.0)
+            buf = b""
+            while len(buf) < _AUTH_LEN:
+                chunk = sock.recv(_AUTH_LEN - len(buf))
+                if not chunk:
+                    return False
+                buf += chunk
+            sock.settimeout(None)
+        except OSError:
+            return False
+        magic, digest = buf[: len(_AUTH_MAGIC)], buf[len(_AUTH_MAGIC):]
+        if magic != _AUTH_MAGIC or not hmac.compare_digest(
+            digest, self._digest
+        ):
+            try:
+                peer = sock.getpeername()
+            except OSError:
+                peer = "?"
+            logger.warning(
+                "runtime endpoint: rejected unauthenticated peer %s",
+                peer,
+            )
+            return False
+        return True
 
     def track(self, sock: socket.socket):
         with self._lock:
@@ -368,11 +563,13 @@ class _Conn:
     per target — parallelism comes from rpc_all's thread pool opening
     distinct connections)."""
 
-    def __init__(self, addr: str, timeout: float):
+    def __init__(self, addr: str, timeout: float, digest: bytes):
         host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection(
             (host, int(port)), timeout=timeout
         )
+        # Prove job membership before the first frame (see module doc).
+        self._sock.sendall(_AUTH_MAGIC + digest)
         self._lock = threading.Lock()
 
     def call(self, req: dict, timeout: Optional[float]) -> dict:
@@ -406,10 +603,18 @@ def _wait_lookup(fn, what: str, timeout: float):
 class QueueHandle:
     """Named queue living on its creator's endpoint."""
 
-    def __init__(self, name: str, registry, resolve_timeout: float = 60.0):
+    def __init__(self, name: str, registry, resolve_timeout: float = 60.0,
+                 digest: Optional[bytes] = None):
         self.name = name
         self._registry = registry
         self._resolve_timeout = resolve_timeout
+        if digest is None:
+            from dlrover_tpu.unified.backend import UnifiedEnv
+
+            digest = _token_digest(resolve_runtime_token(
+                os.getenv(UnifiedEnv.JOB_NAME, "")
+            ))
+        self._digest = digest
         self._conn: Optional[_Conn] = None
 
     def _ensure(self) -> _Conn:
@@ -425,7 +630,9 @@ class QueueHandle:
                 # be caught by the callers' no-resend TimeoutError path.
                 raise RpcError(str(e)) from None
             try:
-                self._conn = _Conn(addr, self._resolve_timeout)
+                self._conn = _Conn(
+                    addr, self._resolve_timeout, self._digest
+                )
             except TimeoutError as e:
                 # Connect-phase timeout (black-holed address): nothing
                 # was sent, so this is safely retryable — route it into
@@ -450,6 +657,14 @@ class QueueHandle:
                 raise RpcError(
                     f"queue {self.name!r} request timed out "
                     f"(NOT retried: the peer may have executed it)"
+                ) from None
+            except ValueError as e:
+                # Protocol error (oversized frame, either direction):
+                # the stream is desynced — drop the connection and
+                # surface the cause; never retry.
+                self.close()
+                raise RpcError(
+                    f"queue {self.name!r} protocol error: {e}"
                 ) from None
             except (ConnectionError, OSError) as e:
                 self.close()
@@ -495,10 +710,15 @@ class RuntimeClient:
     construct one directly for any job."""
 
     def __init__(self, job_name: str, backend: Optional[str] = None,
-                 resolve_timeout: float = 60.0):
+                 resolve_timeout: float = 60.0,
+                 token: Optional[str] = None):
         self.job_name = job_name
         self.registry = create_registry(job_name, backend)
         self._resolve_timeout = resolve_timeout
+        self._digest = _token_digest(
+            token if token is not None
+            else resolve_runtime_token(job_name)
+        )
         self._conns: Dict[str, _Conn] = {}
         self._lock = threading.Lock()
 
@@ -519,13 +739,24 @@ class RuntimeClient:
             # of the callers' no-resend TimeoutError path.
             raise RpcError(str(e)) from None
         try:
-            conn = _Conn(addr, self._resolve_timeout)
+            conn = _Conn(addr, self._resolve_timeout, self._digest)
         except TimeoutError as e:
             # Connect-phase timeout: nothing sent — retryable, so route
             # it into the dead-peer path, not the no-resend one.
             raise ConnectionError(f"connect to {addr} timed out") from e
         with self._lock:
-            self._conns[key] = conn
+            # Two threads can race past the cache miss; keep the first
+            # registered connection and close the loser so no socket
+            # leaks (concurrent rpc() calls outside rpc_all).
+            existing = self._conns.get(key)
+            if existing is not None:
+                loser = conn
+                conn = existing
+            else:
+                self._conns[key] = conn
+                loser = None
+        if loser is not None:
+            loser.close()
         return conn
 
     def _drop_conn(self, role: str, rank: int):
@@ -558,6 +789,14 @@ class RuntimeClient:
                     f"rpc {role}[{rank}].{method} timed out after "
                     f"{timeout}s (NOT retried: the peer may have "
                     f"executed it)"
+                ) from None
+            except ValueError as e:
+                # Protocol error (oversized frame, either direction):
+                # the connection is desynced — drop it and surface the
+                # cause; never retry.
+                self._drop_conn(role, rank)
+                raise RpcError(
+                    f"rpc {role}[{rank}].{method} protocol error: {e}"
                 ) from None
             except (ConnectionError, OSError) as e:
                 self._drop_conn(role, rank)
@@ -593,7 +832,10 @@ class RuntimeClient:
             return [f.result() for f in futs]
 
     def queue(self, name: str) -> QueueHandle:
-        return QueueHandle(name, self.registry, self._resolve_timeout)
+        return QueueHandle(
+            name, self.registry, self._resolve_timeout,
+            digest=self._digest,
+        )
 
     def close(self):
         with self._lock:

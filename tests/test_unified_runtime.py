@@ -212,3 +212,121 @@ def test_unregistered_target_raises_rpc_error(job_env):
     with pytest.raises(RpcError, match="not registered"):
         client.queue("ghost-q").get(timeout=0.1)
     client.close()
+
+
+def test_wrong_token_client_is_refused(job_env):
+    """The data plane unpickles payloads — a peer that cannot present
+    the job secret must be dropped before its first frame is parsed
+    (VERDICT r3 #5: unauthenticated pickle endpoint = RCE)."""
+    ep = WorkerEndpoint()
+    try:
+        FileRegistry(job_env).register_worker("trainer", 0, ep.addr)
+        ep.export("add", lambda a, b: a + b)
+
+        good = RuntimeClient(job_env, resolve_timeout=5.0)
+        assert good.rpc("trainer", "add", 1, 1) == 2
+        good.close()
+
+        bad = RuntimeClient(
+            job_env, resolve_timeout=1.0, token="not-the-job-secret"
+        )
+        with pytest.raises(RpcError, match="unreachable"):
+            bad.rpc("trainer", "add", 1, 1)
+        bad.close()
+        # The endpoint must still serve authenticated peers afterwards.
+        good = RuntimeClient(job_env, resolve_timeout=5.0)
+        assert good.rpc("trainer", "add", 2, 2) == 4
+        good.close()
+    finally:
+        ep.close()
+
+
+def test_raw_garbage_connection_never_reaches_dispatch(job_env):
+    """A peer spraying bytes without the auth preamble gets its
+    connection closed with no reply and no pickle.loads call."""
+    import pickle
+    import socket as socket_mod
+
+    ep = WorkerEndpoint()
+    try:
+        called = []
+        ep.export("probe", lambda: called.append(1))
+        host, port = ep.addr.rsplit(":", 1)
+        # A well-formed frame (as sent by a pre-auth-era client) must be
+        # treated as a failed handshake, not dispatched.
+        frame = pickle.dumps({"kind": "rpc", "method": "probe"})
+        s = socket_mod.create_connection((host, int(port)), timeout=5.0)
+        s.sendall(len(frame).to_bytes(8, "big") + frame)
+        s.settimeout(2.0)
+        assert s.recv(1) == b"", "server replied to unauthenticated peer"
+        s.close()
+        assert not called
+    finally:
+        ep.close()
+
+
+def test_queue_wrong_token_refused(job_env):
+    ep = WorkerEndpoint()
+    try:
+        ep.create_queue("q1")
+        FileRegistry(job_env).register_queue("q1", ep.addr)
+        bad = RuntimeClient(
+            job_env, resolve_timeout=1.0, token="wrong"
+        )
+        with pytest.raises(RpcError, match="unreachable"):
+            bad.queue("q1").put({"x": 1}, timeout=0.5)
+        bad.close()
+        good = RuntimeClient(job_env, resolve_timeout=5.0)
+        good.queue("q1").put({"x": 1}, timeout=5.0)
+        assert good.queue("q1").get(timeout=5.0) == {"x": 1}
+        good.close()
+    finally:
+        ep.close()
+
+
+def test_manager_injects_runtime_token(job_env):
+    """worker_envs must carry the job secret so Ray workers on other
+    nodes (no shared runtime dir) can still authenticate."""
+    from dlrover_tpu.unified.backend import worker_envs
+    from dlrover_tpu.unified.graph import Vertex
+    from dlrover_tpu.unified.rpc import resolve_runtime_token
+
+    v = Vertex(role="actor", rank=0, world_size=1, group_index=0)
+    envs = worker_envs(v, job_env)
+    assert envs[UnifiedEnv.RUNTIME_TOKEN] == resolve_runtime_token(
+        job_env
+    )
+
+
+def test_oversized_frames_surface_cap_error(job_env, monkeypatch):
+    """Over-cap frames must surface the cap (and its env override) as
+    an RpcError — never a blind reconnect-and-re-send loop."""
+    import dlrover_tpu.unified.rpc as rpc_mod
+
+    monkeypatch.setattr(rpc_mod, "_MAX_MSG", 1 << 16)
+    ep = WorkerEndpoint()
+    try:
+        FileRegistry(job_env).register_worker("t", 0, ep.addr)
+        calls = []
+
+        def big_reply():
+            calls.append(1)
+            return np.zeros(1 << 20, np.uint8)  # 1MB >> 64KB cap
+
+        ep.export("big", big_reply)
+        ep.export("ok", lambda: "fine")
+        client = RuntimeClient(job_env, resolve_timeout=3.0)
+        # Client-side: an over-cap REQUEST is rejected before any byte
+        # is sent.
+        with pytest.raises(RpcError, match="RUNTIME_MAX_MSG"):
+            client.rpc("t", "ok", np.zeros(1 << 20, np.uint8))
+        # Server-side: an over-cap REPLY comes back as an error frame,
+        # executed exactly once (no reconnect-and-re-execute).
+        with pytest.raises(RpcError, match="unsendable reply"):
+            client.rpc("t", "big")
+        assert len(calls) == 1
+        # The connection survives for well-formed traffic.
+        assert client.rpc("t", "ok") == "fine"
+        client.close()
+    finally:
+        ep.close()
