@@ -18,13 +18,22 @@ Measured phases (from the timestamped event log the worker writes):
   replay_s           restored -> training regained the pre-kill step
   measured_recovery_s  sum: kill -> regained
 
+The worker saves at the Young/Daly-autotuned cadence computed from its
+OWN measured save cost (flash_ckpt/autotune.py — the production
+autotuner), and the parent kills mid-interval, so the replayed work
+equals the expected half-interval a real failure loses. The restarted
+incarnation AOT-compiles the train step concurrently with the restore
+H2D transfer (shapes are known from specs) and times the restore with a
+real host-fetch barrier — ``jax.block_until_ready`` returns early on
+async-dispatch tunnels, which previously leaked H2D cost into replay.
+
 The JSON line also reports ``e2e_goodput_pct``: goodput at the
-reference's operating point (MTBF 3600s, save every 60s — the basis of
-DLRover's 69%->95% claim, README.md:61-63) using the MEASURED downtime
-including process restart, alongside the formula-only number bench.py
-prints. The worker enables JAX's persistent compilation cache so the
-restarted incarnation compiles from cache — exactly how a production
-TPU job restarts.
+reference's operating point (MTBF 3600s — the basis of DLRover's
+69%->95% claim, README.md:61-63) using the MEASURED downtime including
+process restart, alongside the formula-only number bench.py prints; the
+legacy 60s cadence is reported for comparability. The worker enables
+JAX's persistent compilation cache so the restarted incarnation
+compiles from cache — exactly how a production TPU job restarts.
 
 Parity: the reference measures recovery the same way operationally
 (docs/blogs/flash_checkpoint.md restore-in-seconds claims) but has no
@@ -43,9 +52,9 @@ MTBF_S = 3600.0
 SAVE_EVERY_S = 60.0
 BASELINE_GOODPUT = 95.0
 
-TOTAL_STEPS = 32
-SAVE_EVERY = 8
-KILL_AFTER_STEP = 20  # mid-interval: last landed save is step 16
+TOTAL_STEPS = 140
+FIRST_SAVE_STEP = 10  # past step-time warmup; later saves follow the
+                      # autotuned cadence the worker computes and emits
 
 
 # ---------------------------------------------------------------------------
@@ -76,6 +85,9 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
     from dlrover_tpu.trainer import train_step as ts
     from dlrover_tpu.trainer.runtime import init_distributed
+
+    from dlrover_tpu.flash_ckpt.autotune import optimal_save_interval_s
+    from dlrover_tpu.flash_ckpt.engine import fetch_barrier
 
     ctx = init_distributed()
     incarnation = ctx.restart_count
@@ -108,12 +120,49 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
     shardings = ts.state_shardings(specs, mesh)
     step_fn, _ = ts.make_train_step(cfg, tc, opt, mesh, donate=False)
 
+    # AOT-compile the train step CONCURRENTLY with the restore H2D
+    # transfer: the shapes are known from the specs, so the restarted
+    # incarnation overlaps its (persistent-cache-served) compile with
+    # the state transfer instead of paying them back to back — the
+    # warmup that dominated replay in earlier rounds.
+    abs_state = {
+        "params": jax.eval_shape(
+            lambda: llama.init_params(cfg, jax.random.key(0))[0]
+        ),
+        "opt_state": jax.eval_shape(
+            opt.init,
+            jax.eval_shape(
+                lambda: llama.init_params(cfg, jax.random.key(0))[0]
+            ),
+        ),
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    abs_batch = {
+        "tokens": jax.ShapeDtypeStruct((batch, seq + 1), jnp.int32)
+    }
+    aot_box = {}
+
+    def _aot():
+        try:
+            with mesh:
+                aot_box["fn"] = step_fn.jitted.lower(
+                    abs_state, abs_batch
+                ).compile()
+        except Exception as e:  # noqa: BLE001 - fall back to lazy jit
+            aot_box["err"] = f"{type(e).__name__}: {e}"
+
+    aot_thread = threading.Thread(target=_aot, daemon=True)
+    aot_thread.start()
+
     ckpt = Checkpointer(ckpt_dir)
     restored = ckpt.load_checkpoint(sharding_tree=shardings)
     if restored is not None:
         rstep, state, _ = restored
-        jax.block_until_ready(state)
-        emit("restored", step=rstep)
+        fetch_barrier(state)  # block_until_ready lies on async tunnels
+        state_mb = sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(state)
+        ) / 1e6
+        emit("restored", step=rstep, mb=round(state_mb, 1))
     else:
         state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
         emit("fresh_start")
@@ -123,21 +172,75 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
     ).astype(jnp.int32)
     jax.block_until_ready(tokens)
     batch_d = {"tokens": tokens}
+    aot_thread.join(timeout=300)
+    run_step = aot_box.get("fn", step_fn)
+    if "err" in aot_box:
+        emit("aot_failed", err=aot_box["err"].replace(" ", "_")[:80])
     emit("data_ready")
+
+    # Saves run the production way: the step loop only pays the device-
+    # snapshot block (~ms); the D2H drain proceeds in a background
+    # thread (4.8s through the tunnel — waiting inline would serialize
+    # it into every interval AND into replay). "saving" marks the
+    # launch (the point defining what a kill loses); "saved" marks the
+    # drained, restorable snapshot the parent may kill after. Cadence:
+    # the Young/Daly optimum from this run's own measured block+drain —
+    # the same autotuner production jobs use (flash_ckpt/autotune.py).
+    save_lock = threading.Lock()
+    save_st = {"auto": None, "last": None, "busy": False}
+    steps_local = 0
+
+    def _drain(step_n, block, launch_t):
+        ckpt.wait_async_save()
+        drain = time.time() - launch_t
+        with save_lock:
+            if save_st["auto"] is None:
+                save_st["auto"] = optimal_save_interval_s(
+                    block, drain_s=drain, mtbf_s=MTBF_S
+                )
+            save_st["busy"] = False
+            cadence = save_st["auto"]
+        emit(
+            "saved", n=step_n, block=round(block, 4),
+            drain=round(drain, 3), cadence=round(cadence, 2),
+        )
 
     while int(state["step"]) < TOTAL_STEPS:
         t0 = time.time()
-        state, m = step_fn(state, batch_d)
+        try:
+            state, m = run_step(state, batch_d)
+        except Exception:  # noqa: BLE001 - AOT input mismatch: fall back
+            if run_step is step_fn:
+                raise
+            run_step = step_fn
+            state, m = run_step(state, batch_d)
         float(m["loss"])  # host fetch: the only reliable barrier
         step = int(state["step"])
+        steps_local += 1
         emit("step", n=step, dur=round(time.time() - t0, 4))
-        if step % SAVE_EVERY == 0:
-            # Async flash save: launch the DMA, overlap with next steps,
-            # then wait for it to land so the parent's kill always finds
-            # a restorable snapshot behind the kill step.
+        with save_lock:
+            due = not save_st["busy"] and (
+                steps_local >= FIRST_SAVE_STEP
+                if save_st["auto"] is None
+                else time.time() - save_st["last"] >= save_st["auto"]
+            )
+            if due:
+                save_st["busy"] = True
+        if due:
+            launch_t = time.time()
             block = ckpt.save_checkpoint_async(step, state)
-            ckpt.wait_async_save()
-            emit("saved", n=step, block=round(block, 4))
+            with save_lock:
+                save_st["last"] = launch_t
+            emit("saving", n=step)
+            threading.Thread(
+                target=_drain, args=(step, block, launch_t), daemon=True
+            ).start()
+    deadline = time.time() + 60
+    while time.time() < deadline:  # let the last drain land
+        with save_lock:
+            if not save_st["busy"]:
+                break
+        time.sleep(0.05)
     ckpt.close()
     emit("done")
     sys.exit(0)
@@ -213,6 +316,9 @@ def main():
         max_restarts=3,
         node_rank=0,
         monitor_interval=0.2,
+        # Restart adopts a pre-spawned interpreter (agent/standby.py):
+        # the ~4s python + jax import cost moves off the recovery path.
+        warm_standby=True,
     )
     agent = ElasticAgent(spec, client, ckpt_saver=saver)
     box = {}
@@ -223,29 +329,43 @@ def main():
     t = threading.Thread(target=run, daemon=True)
     t.start()
 
-    # Wait until the first incarnation passes KILL_AFTER_STEP with a
-    # landed checkpoint behind it, then kill it hard (preemption).
+    # Kill mid-interval at the worker's own autotuned cadence: a save's
+    # LAUNCH defines what a kill loses; its "saved" event means the
+    # snapshot drained and is restorable. Kill cadence/2 past the
+    # latest restorable launch so the replayed work equals the expected
+    # half-interval a production failure loses — then SIGKILL.
     deadline = time.time() + 900
     t_kill = None
     while time.time() < deadline:
         rows = parse_events(events_path)
-        steps0 = [
-            int(kw["n"])
-            for _, inc, ev, kw in rows
-            if inc == 0 and ev == "step"
-        ]
-        saved0 = [
-            int(kw["n"])
+        launches = {
+            int(kw["n"]): t_
+            for t_, inc, ev, kw in rows
+            if inc == 0 and ev == "saving"
+        }
+        drained = [
+            kw
             for _, inc, ev, kw in rows
             if inc == 0 and ev == "saved"
         ]
-        if steps0 and max(steps0) >= KILL_AFTER_STEP and saved0:
-            pid = agent._workers[0].process.pid
-            t_kill = time.time()
-            os.kill(pid, signal.SIGKILL)
-            break
+        done0 = any(
+            inc == 0 and ev == "done" for _, inc, ev, _kw in rows
+        )
+        assert not done0, (
+            "worker finished before the mid-interval kill — raise "
+            "TOTAL_STEPS above cadence/2 worth of steps"
+        )
+        if drained:
+            kw = drained[-1]
+            t_launch = launches[int(kw["n"])]
+            kill_at = t_launch + float(kw["cadence"]) / 2.0
+            if time.time() >= kill_at:
+                pid = agent._workers[0].process.pid
+                t_kill = time.time()
+                os.kill(pid, signal.SIGKILL)
+                break
         time.sleep(0.1)
-    assert t_kill is not None, "worker never reached the kill step"
+    assert t_kill is not None, "worker never reached the kill point"
 
     t.join(timeout=900)
     ok = box.get("result") == RunResult.SUCCEEDED
@@ -329,11 +449,18 @@ def main():
         effective_recovery = (
             detect + init + restore + replay_warmup + auto_every / 2.0
         )
+        state_mb = float(restored_kw.get("mb", 0.0))
         result.update(
             value=round(recovery, 3),
             detect_restart_s=round(detect, 3),
             runtime_init_s=round(init, 3),
             restore_s=round(restore, 3),
+            # Restore is wire-bound on tunneled dev chips: the H2D
+            # transfer of the full train state dominates, so report the
+            # bytes and achieved bandwidth next to the seconds (on a
+            # host-attached TPU the same machinery restores in ~ms).
+            restore_state_mb=round(state_mb, 1),
+            restore_mb_per_s=round(state_mb / max(restore, 1e-9), 1),
             replay_s=round(replay, 3),
             replayed_steps=lost_steps,
             step_time_s=round(step_s, 4),

@@ -1,0 +1,40 @@
+"""Warm-standby worker process.
+
+Restart latency on a failure is dominated by interpreter + framework
+import time (this container's sitecustomize imports jax at startup:
+~4s measured — the torch analogue in the reference's world is similar).
+A standby is a pre-spawned interpreter that has already paid that cost
+and blocks on stdin until the agent ADOPTS it as the next worker
+incarnation: the agent writes one JSON line carrying the final
+environment and argv (rendezvous outcome, restart count — values that
+do not exist when the standby is spawned), and the standby becomes the
+worker via runpy in-process. No TPU/JAX client is created while waiting
+— importing jax registers backends but initializes nothing, so the
+standby never contends for the chip with the live worker.
+
+Spawned by ElasticAgent when ``WorkerSpec.warm_standby`` is set (see
+agent/training.py); exercised end-to-end by bench_e2e.py.
+"""
+
+import json
+import os
+import runpy
+import sys
+
+
+def wait_and_exec():
+    line = sys.stdin.readline()
+    if not line:
+        # Agent closed stdin without adopting (job ended): exit clean.
+        sys.exit(0)
+    go = json.loads(line)
+    os.environ.update(go["env"])
+    sys.argv = list(go["argv"])
+    if go.get("module"):
+        runpy.run_module(go["module"], run_name="__main__", alter_sys=True)
+    else:
+        runpy.run_path(go["argv"][0], run_name="__main__")
+
+
+if __name__ == "__main__":
+    wait_and_exec()

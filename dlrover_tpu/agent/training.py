@@ -19,6 +19,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -61,6 +62,11 @@ class WorkerSpec:
     monitor_interval: float = 1.0
     env: Dict[str, str] = field(default_factory=dict)
     redirect_output: Optional[str] = None  # dir for per-worker logs
+    # Keep a pre-spawned interpreter (python + framework imports
+    # already paid) and adopt it as the next incarnation on restart —
+    # cuts restart latency by the ~4s import cost (agent/standby.py).
+    # Honored for nproc_per_node == 1.
+    warm_standby: bool = False
 
 
 @dataclass
@@ -105,6 +111,9 @@ class ElasticAgent:
             join_timeout=spec.join_timeout,
         )
         self._workers: List[_Worker] = []
+        self._standby: Optional[subprocess.Popen] = None
+        self._standby_log = None
+        self._breakpoint_thread: Optional[threading.Thread] = None
         self._restart_count = 0
         self._ckpt_saver = ckpt_saver
         self._last_heartbeat = 0.0
@@ -137,83 +146,179 @@ class ElasticAgent:
             self._start_workers_inner(outcome, spec)
             span.content["num_workers"] = len(self._workers)
 
-    def _start_workers_inner(self, outcome: RendezvousOutcome, spec):
-        self._workers = []
+    def _base_worker_env(self, spec) -> Dict[str, str]:
+        """Environment shared by every incarnation (and by standbys):
+        everything except the rendezvous-outcome values."""
         # Workers must be able to import this framework even when the
         # launcher was started from a different cwd/PYTHONPATH.
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
-        # Zero-cooperation profiling: when XLA capture is enabled, the
-        # injection dir's sitecustomize arms the listener at interpreter
-        # startup even if the train script never imports this framework
-        # (reference xpu_timer's LD_PRELOAD contract). It chain-loads
-        # any sitecustomize it shadows.
-        inject_dir = os.path.join(
-            pkg_root, "dlrover_tpu", "tpu_timer", "_inject"
-        )
-        for local_rank in range(spec.nproc_per_node):
-            env = dict(os.environ)
-            existing = env.get("PYTHONPATH", "")
-            if pkg_root not in existing.split(os.pathsep):
-                env["PYTHONPATH"] = (
-                    f"{existing}{os.pathsep}{pkg_root}" if existing else pkg_root
-                )
-            env.update(spec.env)
-            # Gate AFTER merging spec.env (the launcher may enable the
-            # flag there).
-            from dlrover_tpu.common.env_utils import env_bool
+        env = dict(os.environ)
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                f"{existing}{os.pathsep}{pkg_root}" if existing else pkg_root
+            )
+        env.update(spec.env)
+        # Gate AFTER merging spec.env (the launcher may enable the
+        # flag there). Zero-cooperation profiling: when XLA capture is
+        # enabled, the injection dir's sitecustomize arms the listener
+        # at interpreter startup even if the train script never imports
+        # this framework (reference xpu_timer's LD_PRELOAD contract).
+        # It chain-loads any sitecustomize it shadows.
+        from dlrover_tpu.common.env_utils import env_bool
 
-            if env_bool(env, "DLROVER_TPU_TIMER_XLA"):
-                env["PYTHONPATH"] = (
-                    f"{inject_dir}{os.pathsep}" + env["PYTHONPATH"]
-                )
-            env.update(
-                worker_env(
-                    coordinator=outcome.coordinator_address,
-                    num_processes=outcome.num_processes,
-                    process_id=outcome.process_id_base + local_rank,
-                    local_rank=local_rank,
-                    local_world_size=spec.nproc_per_node,
-                    restart_count=self._restart_count,
-                    rdzv_round=outcome.round,
-                    node_ranks=list(outcome.world),
-                    num_slices=outcome.num_slices,
-                )
+        if env_bool(env, "DLROVER_TPU_TIMER_XLA"):
+            inject_dir = os.path.join(
+                pkg_root, "dlrover_tpu", "tpu_timer", "_inject"
             )
-            if spec.entrypoint.startswith("-m "):
-                cmd = [
-                    sys.executable,
-                    "-m",
-                    spec.entrypoint[3:].strip(),
-                    *spec.args,
-                ]
+            env["PYTHONPATH"] = (
+                f"{inject_dir}{os.pathsep}" + env["PYTHONPATH"]
+            )
+        return env
+
+    def _outcome_env(
+        self, outcome: RendezvousOutcome, local_rank: int, spec
+    ) -> Dict[str, str]:
+        return worker_env(
+            coordinator=outcome.coordinator_address,
+            num_processes=outcome.num_processes,
+            process_id=outcome.process_id_base + local_rank,
+            local_rank=local_rank,
+            local_world_size=spec.nproc_per_node,
+            restart_count=self._restart_count,
+            rdzv_round=outcome.round,
+            node_ranks=list(outcome.world),
+            num_slices=outcome.num_slices,
+        )
+
+    def _worker_argv(self, spec) -> tuple:
+        """(argv-after-python, module-or-None) for the entrypoint."""
+        if spec.entrypoint.startswith("-m "):
+            module = spec.entrypoint[3:].strip()
+            return [module, *spec.args], module
+        return [spec.entrypoint, *spec.args], None
+
+    def _open_worker_log(self, spec, local_rank: int):
+        if not spec.redirect_output:
+            return None
+        os.makedirs(spec.redirect_output, exist_ok=True)
+        path = os.path.join(
+            spec.redirect_output,
+            f"worker-{spec.node_rank}-{local_rank}.log",
+        )
+        return open(path, "ab")
+
+    def _start_workers_inner(self, outcome: RendezvousOutcome, spec):
+        self._workers = []
+        for local_rank in range(spec.nproc_per_node):
+            env = self._base_worker_env(spec)
+            env.update(self._outcome_env(outcome, local_rank, spec))
+            argv, module = self._worker_argv(spec)
+            adopted = (
+                local_rank == 0
+                and self._adopt_standby(env, argv, module)
+            )
+            if adopted:
+                proc, log_file = adopted
             else:
-                cmd = [sys.executable, spec.entrypoint, *spec.args]
-            log_file = None
-            stdout = stderr = None
-            if spec.redirect_output:
-                os.makedirs(spec.redirect_output, exist_ok=True)
-                path = os.path.join(
-                    spec.redirect_output,
-                    f"worker-{spec.node_rank}-{local_rank}.log",
-                )
-                log_file = open(path, "ab")
+                if module is not None:
+                    cmd = [sys.executable, "-m", *argv]
+                else:
+                    cmd = [sys.executable, *argv]
+                log_file = self._open_worker_log(spec, local_rank)
                 stdout = stderr = log_file
-            proc = subprocess.Popen(
-                cmd,
-                env=env,
-                stdout=stdout,
-                stderr=stderr,
-                start_new_session=True,
-            )
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=stdout,
+                    stderr=stderr,
+                    start_new_session=True,
+                )
             self._workers.append(_Worker(local_rank, proc, log_file))
             logger.info(
-                "started worker local_rank=%d pid=%d process_id=%d",
+                "started worker local_rank=%d pid=%d process_id=%d%s",
                 local_rank,
                 proc.pid,
                 outcome.process_id_base + local_rank,
+                " (adopted warm standby)" if adopted else "",
             )
+        if spec.warm_standby and spec.nproc_per_node == 1:
+            self._spawn_standby(spec)
+
+    # ---- warm standby ------------------------------------------------------
+
+    def _spawn_standby(self, spec):
+        """Pre-spawn the NEXT incarnation's interpreter so a restart
+        skips the ~4s python + framework import cost (agent/standby.py).
+        The standby blocks on stdin; it never touches the accelerator
+        until adopted."""
+        if self._standby is not None and self._standby.poll() is None:
+            return
+        self._standby_log = self._open_worker_log(spec, 0)
+        try:
+            self._standby = subprocess.Popen(
+                [sys.executable, "-m", "dlrover_tpu.agent.standby"],
+                env=self._base_worker_env(spec),
+                stdin=subprocess.PIPE,
+                stdout=self._standby_log,
+                stderr=self._standby_log,
+                start_new_session=True,
+            )
+            logger.info("warm standby spawned pid=%d", self._standby.pid)
+        except OSError:
+            logger.warning("standby spawn failed", exc_info=True)
+            self._standby = None
+
+    def _adopt_standby(self, env, argv, module):
+        """Hand the final env/argv to a live standby; returns
+        (process, log_file) or None (no/dead standby -> cold spawn)."""
+        standby, log_file = self._standby, self._standby_log
+        self._standby = self._standby_log = None
+        if standby is None:
+            if log_file:  # spawn-failed leftovers must not leak the fd
+                log_file.close()
+            return None
+        if standby.poll() is not None:
+            if log_file:
+                log_file.close()
+            return None
+        try:
+            import json as json_mod
+
+            line = json_mod.dumps(
+                {"env": env, "argv": argv, "module": module}
+            )
+            standby.stdin.write(line.encode() + b"\n")
+            standby.stdin.flush()
+            standby.stdin.close()
+        except (OSError, ValueError):
+            logger.warning("standby adoption failed; cold spawn",
+                           exc_info=True)
+            try:
+                standby.kill()
+            except OSError:
+                pass
+            if log_file:
+                log_file.close()
+            return None
+        return standby, log_file
+
+    def _close_standby(self):
+        standby, log_file = self._standby, self._standby_log
+        self._standby = self._standby_log = None
+        if standby is not None and standby.poll() is None:
+            try:
+                standby.stdin.close()  # EOF -> clean exit
+                standby.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    standby.kill()
+                except OSError:
+                    pass
+        if log_file:
+            log_file.close()
 
     def _stop_workers(self, timeout: float = 15.0, post_mortem: bool = False):
         if post_mortem:
@@ -315,10 +420,39 @@ class ElasticAgent:
         codes = self._failed_exit_codes()
         logger.warning("worker failure, exit codes %s", codes)
         if self._ckpt_saver is not None:
-            try:
-                self._ckpt_saver.save_shm_on_failure()
-            except Exception:
-                logger.exception("breakpoint checkpoint save failed")
+            # Breakpoint save runs in the background: a same-host
+            # restart restores MEMORY-FIRST from the shm image (owned
+            # by this agent process, so it survives the worker), and
+            # the storage persist only protects the node-loss case —
+            # where minutes of latency are fine — so the restart
+            # needn't wait the seconds a large state takes to persist.
+            # The persist only READS shm (serialized against new saves
+            # by the per-rank locks). A crash-looping worker must not
+            # stack concurrent saves (save_shm_on_failure is not
+            # self-reentrant): if the previous persist is still running
+            # after the join grace, skip this round — the next failure
+            # or cadence save covers it.
+            prev = self._breakpoint_thread
+            if prev is not None:
+                prev.join(timeout=60.0)
+            if prev is not None and prev.is_alive():
+                logger.warning(
+                    "previous breakpoint save still running; skipping"
+                )
+            else:
+                def _breakpoint_save():
+                    try:
+                        self._ckpt_saver.save_shm_on_failure()
+                    except Exception:
+                        logger.exception(
+                            "breakpoint checkpoint save failed"
+                        )
+
+                self._breakpoint_thread = threading.Thread(
+                    target=_breakpoint_save, daemon=True,
+                    name="breakpoint-save",
+                )
+                self._breakpoint_thread.start()
         from dlrover_tpu.agent.diagnosis_agent import (
             FailureContext,
             WorkerAction,
@@ -392,6 +526,7 @@ class ElasticAgent:
             return RunResult.RELAUNCH
         finally:
             self._diagnosis_agent.stop()
+            self._close_standby()
 
     def _run(self) -> RunResult:
         spec = self._spec
@@ -454,3 +589,6 @@ class ElasticAgent:
         self._stopping = True
         self._diagnosis_agent.stop()
         self._stop_workers()
+        self._close_standby()
+        if self._breakpoint_thread is not None:
+            self._breakpoint_thread.join(timeout=60.0)

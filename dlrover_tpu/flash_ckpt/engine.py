@@ -616,3 +616,36 @@ def to_device_state(np_state, sharding_tree=None):
         )
 
     return jax.tree_util.tree_map(put, np_state, sharding_tree)
+
+
+_fetch_probe = None
+
+
+def fetch_barrier(tree) -> float:
+    """Reliable completion barrier over every leaf of ``tree``.
+
+    ``jax.block_until_ready`` can return before async dispatch actually
+    lands on remote-attached backends (measured on the axon tunnel), so
+    restore timings taken with it silently leak the H2D cost into
+    whatever runs next. This fetches ONE element of every leaf through a
+    single jitted reduction — one dispatch, and the host fetch cannot
+    complete until every input transfer has."""
+    import jax
+    import jax.numpy as jnp
+
+    global _fetch_probe
+    if _fetch_probe is None:
+        def probe(leaves):
+            acc = jnp.zeros((), jnp.float32)
+            for leaf in leaves:
+                acc = acc + jnp.sum(
+                    jnp.ravel(leaf)[:1].astype(jnp.float32)
+                )
+            return acc
+
+        _fetch_probe = jax.jit(probe)
+    leaves = [
+        x for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype")
+    ]
+    return float(_fetch_probe(leaves))

@@ -225,6 +225,10 @@ def make_train_step(
         with mesh:
             return jitted(state, batch)
 
+    # The raw jit object, for AOT compilation (``run.jitted.lower(
+    # abstract_state, abstract_batch).compile()``) — restart paths
+    # overlap the compile with the restore H2D (bench_e2e.py).
+    run.jitted = jitted
     return run, specs
 
 

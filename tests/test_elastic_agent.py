@@ -157,3 +157,42 @@ def test_agent_gives_up_after_max_restarts(master, saver_client, tmp_path):
     spec, out = _spec(tmp_path, total=3, crash_at=1, max_restarts=0)
     agent = ElasticAgent(spec, client, ckpt_saver=saver)
     assert agent.run() == RunResult.FAILED
+
+
+def test_warm_standby_adopted_on_restart(master, saver_client, tmp_path):
+    """With warm_standby, the restarted incarnation IS the pre-spawned
+    standby process (no cold python start on the restart path), and the
+    job still resumes from the checkpoint."""
+    client, saver = saver_client
+    spec, out = _spec(tmp_path, total=12)
+    spec.warm_standby = True
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    result_box = {}
+
+    def run():
+        result_box["result"] = agent.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if len(_read_progress(out)) >= 3 and agent._standby is not None:
+            break
+        time.sleep(0.1)
+    assert agent._standby is not None, "standby never spawned"
+    standby_pid = agent._standby.pid
+    worker_pid = agent._workers[0].process.pid
+    assert standby_pid != worker_pid
+    os.kill(worker_pid, signal.SIGKILL)
+    t.join(timeout=60)
+    assert result_box.get("result") == RunResult.SUCCEEDED
+    progress = _read_progress(out)
+    steps = [p[1] for p in progress]
+    assert steps[-1] == 12
+    restarted_steps = [s for _, s, r, _ in progress if r >= 1]
+    assert restarted_steps and min(restarted_steps) > 1
+    # the new incarnation is the adopted standby, and a fresh standby
+    # replaced it (until run() closed it on success)
+    adopted = [w for w in agent._workers if w.process.pid == standby_pid]
+    assert adopted, "restart did not adopt the warm standby"
+    assert agent._standby is None, "standby not closed after run()"
