@@ -306,18 +306,35 @@ def attention_out(config: TpuLMConfig, p, attn, residual):
     return with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
-def _moe_use_dropless(config) -> bool:
-    """Dropless grouped-matmul MoE needs data-dependent group sizes,
-    which GSPMD cannot shard over an ep axis — auto picks it only when
-    the mesh has no expert parallelism."""
-    if config.moe_impl == "dropless":
-        return True
-    if config.moe_impl == "gshard":
-        return False
+def _moe_resolve_impl(config) -> str:
+    """Which MoE path runs: "gshard" | "dropless" | "dropless_sharded"
+    | "dropless_ep".
+
+    Explicit ``moe_impl="dropless"`` maps to the mesh-appropriate
+    dropless variant: the single-device core, the shard_map-per-shard
+    form on multi-device meshes without ep (the global-argsort core has
+    data-dependent group sizes GSPMD cannot lower soundly — it must
+    never see a sharded batch directly), or the ragged-all-to-all ep
+    form. "auto" follows the measured crossover (bench.py
+    moe_crossover_sweep, v5e): gshard wins at the default capacity
+    factor (1.25: e.g. 9.3 vs 12.9 ms/layer at 8 experts), dropless
+    wins once the capacity budget reaches ~2.0 — and at that point it
+    is also drop-free, so auto picks it there. Multi-device auto stays
+    on the GSPMD-proven gshard path."""
     from dlrover_tpu.parallel.sharding import current_mesh
 
     mesh = current_mesh()
-    return mesh is None or dict(mesh.shape).get("ep", 1) == 1
+    multi = mesh is not None and mesh.size > 1
+    has_ep = mesh is not None and dict(mesh.shape).get("ep", 1) > 1
+    if config.moe_impl == "gshard":
+        return "gshard"
+    if config.moe_impl == "dropless":
+        if has_ep:
+            return "dropless_ep"
+        return "dropless_sharded" if multi else "dropless"
+    if not multi and config.capacity_factor >= 2.0:
+        return "dropless"
+    return "gshard"
 
 
 def mlp_block(config: TpuLMConfig, p, x):
@@ -327,22 +344,28 @@ def mlp_block(config: TpuLMConfig, p, x):
     residual = x
     hx = rms_norm(x, p["mlp_norm"]).astype(cdt)
     if config.n_experts > 0:
-        if _moe_use_dropless(config):
+        impl = _moe_resolve_impl(config)
+        experts = (p["router"], p["w_gate"], p["w_up"], p["w_down"])
+        if impl == "dropless":
             out, metrics = moe_lib.moe_mlp_dropless(
-                hx,
-                p["router"],
-                p["w_gate"],
-                p["w_up"],
-                p["w_down"],
+                hx, *experts, top_k=config.moe_top_k
+            )
+        elif impl in ("dropless_sharded", "dropless_ep"):
+            from dlrover_tpu.parallel.sharding import current_mesh
+
+            fn = (
+                moe_lib.moe_mlp_dropless_ep
+                if impl == "dropless_ep"
+                else moe_lib.moe_mlp_dropless_sharded
+            )
+            out, metrics = fn(
+                hx, *experts, mesh=current_mesh(),
                 top_k=config.moe_top_k,
             )
         else:
             out, metrics = moe_lib.moe_mlp(
                 hx,
-                p["router"],
-                p["w_gate"],
-                p["w_up"],
-                p["w_down"],
+                *experts,
                 top_k=config.moe_top_k,
                 capacity_factor=config.capacity_factor,
             )

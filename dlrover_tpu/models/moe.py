@@ -15,7 +15,15 @@ Two implementations behind one surface:
   ever dropped and no capacity/one-hot FLOPs are wasted. Group sizes
   are data-dependent, which GSPMD cannot shard over ``ep`` — this path
   is for meshes with ep == 1 (each device holds all experts; dp/tp as
-  usual). ``models/llama.mlp_block`` picks it automatically there.
+  usual). ``models/llama.mlp_block`` picks it automatically on
+  single-device meshes only (auto-selection under multi-device meshes
+  stays with the GSPMD-proven gshard path).
+- **dropless under ep** (:func:`moe_mlp_dropless_ep`): the dropless
+  property survives expert scaling via ``shard_map`` — each ep shard
+  routes its local tokens, ships them to their experts' shards with
+  ``jax.lax.ragged_all_to_all`` (sized by the actual routing, no
+  capacity bound), runs the per-shard grouped matmuls, and ships
+  results back through the reverse ragged exchange.
 
 The reference has no MoE/EP support (SURVEY.md section 2.9: "absent") —
 this is parity-plus for the TPU build.
@@ -26,6 +34,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dlrover_tpu.parallel.sharding import with_logical_constraint
 
@@ -158,32 +167,72 @@ def _tile(dim: int, cap: int = 512) -> int:
     return t
 
 
-def moe_mlp_dropless(
-    x,
-    router_w,     # [embed, experts]
-    w_gate,       # [experts, embed, mlp]
-    w_up,         # [experts, embed, mlp]
-    w_down,       # [experts, mlp, embed]
-    top_k: int = 2,
-    interpret=None,
-):
-    """x: [batch, seq, embed] -> (out, MoEMetrics). Zero dropped tokens.
+# The dispatch/combine gathers are permutation-shaped, and XLA's
+# transpose of a gather is a SCATTER(-add) — slow on TPU and the bulk
+# of the dropless path's overhead in the backward. Both inverses are
+# already in hand (argsort byproducts), so custom VJPs express every
+# backward as another gather: zero scatters in fwd+bwd.
 
-    Token copies are stably sorted by their routed expert; the three
-    expert matmuls then run as ONE grouped matmul each over the sorted
-    rows (megablox gmm: contiguous per-expert row groups hit the MXU
-    with no one-hot dispatch algebra and no capacity padding). The
-    scatter back is a segment-sum over the k copies of each token.
-    """
+
+@jax.custom_vjp
+def _permute_rows(x, perm, inv_perm):
+    """x[perm] where ``inv_perm`` is perm's inverse permutation."""
+    return jnp.take(x, perm, axis=0)
+
+
+def _permute_fwd(x, perm, inv_perm):
+    return jnp.take(x, perm, axis=0), (perm, inv_perm)
+
+
+def _permute_bwd(res, g):
+    perm, inv_perm = res
+    return (
+        jnp.take(g, inv_perm, axis=0),
+        np.zeros(perm.shape, jax.dtypes.float0),
+        np.zeros(inv_perm.shape, jax.dtypes.float0),
+    )
+
+
+_permute_rows.defvjp(_permute_fwd, _permute_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gather_dispatch(xf, order, inv_order, top_k):
+    """xs[i] = xf[order[i] // top_k] (each token duplicated top_k
+    times, sorted by expert). Backward: unsort to token-major and
+    reduce the k copies densely — no scatter."""
+    return jnp.take(xf, order // top_k, axis=0)
+
+
+def _dispatch_fwd(xf, order, inv_order, top_k):
+    return (
+        jnp.take(xf, order // top_k, axis=0),
+        (order, inv_order, xf.shape[0]),
+    )
+
+
+def _dispatch_bwd(top_k, res, g):
+    order, inv_order, n = res
+    d = g.shape[-1]
+    gt = jnp.take(g, inv_order, axis=0).reshape(n, top_k, d)
+    return (
+        jnp.sum(gt.astype(jnp.float32), axis=1).astype(g.dtype),
+        np.zeros(order.shape, jax.dtypes.float0),
+        np.zeros(inv_order.shape, jax.dtypes.float0),
+    )
+
+
+_gather_dispatch.defvjp(_dispatch_fwd, _dispatch_bwd)
+
+
+def _dropless_core(xf, router_w, w_gate, w_up, w_down, top_k, interpret):
+    """Sorted grouped-matmul expert compute over flat tokens [n, d] ->
+    out [n, d] f32. Local to one device (all experts resident)."""
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    b, s, d = x.shape
+    n, d = xf.shape
     e = router_w.shape[-1]
-    n = b * s
     m = n * top_k
-    xf = x.reshape(n, d)
 
     router_logits = jnp.einsum(
         "nd,de->ne", xf.astype(jnp.float32),
@@ -197,38 +246,357 @@ def moe_mlp_dropless(
 
     flat_expert = experts.reshape(m)
     order = jnp.argsort(flat_expert, stable=True)       # [m]
-    token_of = order // top_k
-    xs = jnp.take(xf, token_of, axis=0)                 # [m, d] sorted
+    inv_order = jnp.argsort(order)
+    xs = _gather_dispatch(xf, order, inv_order, top_k)  # [m, d] sorted
     group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
 
     # gmm needs tile-divisible dims; pad the row dim with zero rows
     # assigned to the LAST group (sorted order keeps them contiguous at
-    # the end) and slice them off before the scatter.
+    # the end) and slice them off before the combine.
     f = w_gate.shape[-1]
     m_pad = ((m + 127) // 128 * 128) if m >= 128 else m
     if m_pad != m:
         xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
         group_sizes = group_sizes.at[e - 1].add(m_pad - m)
-    tiling = (_tile(m_pad), _tile(d), _tile(f))
-    run = functools.partial(gmm, interpret=interpret, tiling=tiling)
-    cdt = x.dtype
-    h = run(xs, w_gate.astype(cdt), group_sizes)
-    u = run(xs, w_up.astype(cdt), group_sizes)
-    a = (jax.nn.silu(h) * u).astype(cdt)
-    out_sorted = run(
-        a, w_down.astype(cdt), group_sizes,
+    cdt = xf.dtype
+    # gate and up share lhs rows and group structure: ONE fused gmm over
+    # the concatenated [e, d, 2f] weights reads the sorted tokens once
+    # (half the lhs HBM traffic and kernel launches of separate calls).
+    w_gu = jnp.concatenate(
+        [w_gate.astype(cdt), w_up.astype(cdt)], axis=-1
+    )
+    hu = gmm(
+        xs, w_gu, group_sizes, interpret=interpret,
+        tiling=(_tile(m_pad), _tile(d), _tile(2 * f)),
+    )
+    a = (jax.nn.silu(hu[:, :f]) * hu[:, f:]).astype(cdt)
+    out_sorted = gmm(
+        a, w_down.astype(cdt), group_sizes, interpret=interpret,
         tiling=(_tile(m_pad), _tile(f), _tile(d)),
     )[:m]                                               # [m, d] f32
 
-    gate_sorted = gates.reshape(m)[order].astype(out_sorted.dtype)
-    out = jnp.zeros((n, d), out_sorted.dtype).at[token_of].add(
-        out_sorted * gate_sorted[:, None]
+    # Combine WITHOUT a [n, d] scatter-add (slow on TPU): invert the
+    # sort permutation (int sort + [m, d] gather), then the k copies of
+    # each token sit contiguously — a dense reshape-sum finishes it.
+    out_tok_major = _permute_rows(out_sorted, inv_order, order)
+    return jnp.sum(
+        out_tok_major.reshape(n, top_k, d)
+        * gates.astype(out_sorted.dtype)[:, :, None],
+        axis=1,
+    )
+
+
+def _global_router_metrics(x, router_w):
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32),
+        router_w.astype(jnp.float32),
+    )
+    aux, z = _router_losses(logits, jax.nn.softmax(logits, axis=-1))
+    return MoEMetrics(
+        aux_loss=aux,
+        router_z_loss=z,
+        dropped_fraction=jnp.zeros((), jnp.float32),
+    )
+
+
+def moe_mlp_dropless(
+    x,
+    router_w,     # [embed, experts]
+    w_gate,       # [experts, embed, mlp]
+    w_up,         # [experts, embed, mlp]
+    w_down,       # [experts, mlp, embed]
+    top_k: int = 2,
+    interpret=None,
+):
+    """x: [batch, seq, embed] -> (out, MoEMetrics). Zero dropped tokens.
+
+    Token copies are stably sorted by their routed expert; the expert
+    matmuls then run as grouped matmuls over the sorted rows (megablox
+    gmm: contiguous per-expert row groups hit the MXU with no one-hot
+    dispatch algebra and no capacity padding). Single-device math —
+    multi-device meshes go through :func:`moe_mlp_dropless_sharded`
+    (ep == 1) or :func:`moe_mlp_dropless_ep` (ep > 1)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, d = x.shape
+    out = _dropless_core(
+        x.reshape(b * s, d), router_w, w_gate, w_up, w_down,
+        top_k, interpret,
     )
     out = with_logical_constraint(
         out.astype(x.dtype).reshape(b, s, d), ("batch", "seq", "embed")
     )
+    return out, _global_router_metrics(x, router_w)
 
-    aux, z = _router_losses(router_logits, probs)
+
+def moe_mlp_dropless_sharded(
+    x,
+    router_w,
+    w_gate,
+    w_up,
+    w_down,
+    mesh,
+    top_k: int = 2,
+    interpret=None,
+):
+    """Dropless MoE on a multi-device mesh WITHOUT expert parallelism:
+    every device holds all experts, so each shard routes and computes
+    its local tokens independently — a ``shard_map`` island over the
+    batch axes with replicated weights. (The global-argsort single-
+    device path has data-dependent group sizes GSPMD cannot lower
+    soundly; this per-shard form sidesteps that entirely.)"""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.parallel.sharding import logical_to_spec
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d = x.shape[-1]
+
+    def body(xl, rw, wg, wu, wd):
+        bl, sl, _ = xl.shape
+        out = _dropless_core(
+            xl.reshape(bl * sl, d), rw, wg, wu, wd, top_k, interpret
+        )
+        return out.astype(xl.dtype).reshape(bl, sl, d)
+
+    xspec = logical_to_spec(("batch", None, None))
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(xspec, P(), P(), P(), P()),
+        out_specs=xspec,
+        check_rep=False,
+    )(x, router_w, w_gate, w_up, w_down)
+    out = with_logical_constraint(out, ("batch", "seq", "embed"))
+    return out, _global_router_metrics(x, router_w)
+
+
+# ---------------------------------------------------------------------------
+# Dropless under expert parallelism: shard_map + ragged all-to-all
+# ---------------------------------------------------------------------------
+
+
+def _exchange(rows, sizes_mat, me, n_shards, axis_name, reverse=False):
+    """One ragged all-to-all hop of ``rows`` ([cap, d], per-shard).
+
+    ``sizes_mat[src, dst]`` — rows src ships to dst — is known on every
+    shard, so each shard derives all four offset/size vectors locally:
+    chunks live densely in SOURCE-major order on the sender and land in
+    SOURCE-major order on the receiver. ``reverse=True`` runs the
+    mirrored exchange (processed rows travel home).
+
+    On TPU this is ``jax.lax.ragged_all_to_all`` (wire bytes sized by
+    the actual routing). XLA:CPU does not implement that opcode, so the
+    virtual-mesh test/dryrun path takes a semantically identical dense
+    ``all_to_all`` of capacity-padded chunks instead."""
+    if reverse:
+        sizes_mat = sizes_mat.T
+    send = sizes_mat[me]                                   # [n_shards]
+    recv = sizes_mat[:, me]
+    input_offsets = jnp.cumsum(send) - send
+    # Where MY chunk starts on each receiver: after every earlier
+    # source's chunk for that receiver.
+    col_excl = jnp.cumsum(sizes_mat, axis=0) - sizes_mat   # [src, dst]
+    output_offsets = col_excl[me]
+    if jax.default_backend() == "tpu":
+        return jax.lax.ragged_all_to_all(
+            rows,
+            jnp.zeros_like(rows),
+            input_offsets.astype(jnp.int32),
+            send.astype(jnp.int32),
+            output_offsets.astype(jnp.int32),
+            recv.astype(jnp.int32),
+            axis_name=axis_name,
+        )
+    cap, d = rows.shape
+    lane = jnp.arange(cap)
+    # Pack: slot j carries my chunk for peer j (zero-padded).
+    src_idx = jnp.clip(input_offsets[:, None] + lane[None, :], 0, cap - 1)
+    valid = lane[None, :] < send[:, None]
+    packed = jnp.where(
+        valid[..., None], jnp.take(rows, src_idx, axis=0), 0
+    )                                                      # [ep, cap, d]
+    arrived = jax.lax.all_to_all(packed, axis_name, 0, 0)  # slot i: from i
+    # Unpack into the contiguous source-major receive layout.
+    pos = col_excl[:, me][:, None] + lane[None, :]         # [src, cap]
+    pos = jnp.where(lane[None, :] < recv[:, None], pos, cap)
+    return (
+        jnp.zeros_like(rows)
+        .at[pos.reshape(-1)]
+        .set(arrived.reshape(-1, d), mode="drop")
+    )
+
+
+def moe_mlp_dropless_ep(
+    x,
+    router_w,
+    w_gate,       # [experts, embed, mlp] — expert dim sharded over ep
+    w_up,
+    w_down,
+    mesh,
+    top_k: int = 2,
+    axis_name: str = "ep",
+    interpret=None,
+):
+    """Dropless MoE that SURVIVES expert parallelism (the ep==1-only
+    restriction of :func:`moe_mlp_dropless` lifted).
+
+    Per ep shard, under ``shard_map``: route local tokens, sort the
+    token copies by expert, ship each shard's copies to the shards
+    owning their experts via ``jax.lax.ragged_all_to_all`` (buffers
+    sized by the ACTUAL routing — no capacity bound, nothing dropped),
+    run the fused grouped matmuls over the received rows, and ship the
+    results back through the mirrored exchange. The all-to-all size
+    matrix is replicated via an all_gather of per-shard counts, so all
+    offset bookkeeping is local arithmetic.
+
+    Worst-case receive buffer is ``top_k * n_global`` rows (all tokens
+    routed to one shard) — the price of true droplessness; the gshard
+    path bounds memory with capacity instead (and drops).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, d = x.shape
+    e = router_w.shape[-1]
+    f = w_gate.shape[-1]
+    ep = dict(mesh.shape).get(axis_name, 1)
+    if e % ep:
+        raise ValueError(f"{e} experts not divisible by ep={ep}")
+    e_loc = e // ep
+    cdt = x.dtype
+
+    # Router losses from the (GSPMD-sharded) global logits — the tiny
+    # [n, e] matmul is recomputed inside the shards for routing.
+    logits_global = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32),
+        router_w.astype(jnp.float32),
+    )
+    aux, z = _router_losses(
+        logits_global, jax.nn.softmax(logits_global, axis=-1)
+    )
+
+    from dlrover_tpu.parallel.sharding import logical_to_spec
+
+    xspec = logical_to_spec(("batch", None, None))
+    # Worst case for one ep shard: every copy in its ep row lands on it
+    # (batch is sharded over e.g. dcn x dp x ep; the exchange stays
+    # within one row of the non-ep batch shards, so other rows' tokens
+    # can never arrive).
+    batch_axes = xspec[0]
+    axes = (
+        (batch_axes,) if isinstance(batch_axes, str)
+        else tuple(batch_axes or ())
+    )
+    other = 1
+    for a in axes:
+        if a != axis_name:
+            other *= dict(mesh.shape).get(a, 1)
+    cap_rows = (b // max(other, 1)) * s * top_k
+    cap_rows = (cap_rows + 127) // 128 * 128
+
+    def body(xl, rw, wg, wu, wd):
+        from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+        me = jax.lax.axis_index(axis_name)
+        bl, sl, _ = xl.shape
+        n_loc = bl * sl
+        m_loc = n_loc * top_k
+        xf = xl.reshape(n_loc, d)
+
+        logits = jnp.einsum(
+            "nd,de->ne", xf.astype(jnp.float32), rw.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, experts = jax.lax.top_k(probs, top_k)       # [n_loc, k]
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+        )
+
+        flat_expert = experts.reshape(m_loc)
+        order = jnp.argsort(flat_expert, stable=True)
+        inv_order = jnp.argsort(order)
+        xs = _gather_dispatch(xf, order, inv_order, top_k)  # [m_loc, d]
+        counts = jnp.bincount(flat_expert, length=e)       # [e]
+
+        # Replicate the full src x dst size matrix and per-(src, local
+        # expert) counts: every shard then derives offsets locally.
+        counts_all = jax.lax.all_gather(counts, axis_name)  # [ep, e]
+        sizes_mat = counts_all.reshape(ep, ep, e_loc).sum(-1)
+
+        xs_pad = jnp.zeros((cap_rows, d), cdt).at[:m_loc].set(
+            xs.astype(cdt)
+        )
+        recv = _exchange(xs_pad, sizes_mat, me, ep, axis_name)
+
+        # Received rows are (src, expert)-major; regroup expert-major
+        # for gmm. Row expert ids reconstruct from the counts matrix
+        # (data-dependent lengths -> repeat with a static total).
+        my_counts = jax.lax.dynamic_slice_in_dim(
+            counts_all, me * e_loc, e_loc, axis=1
+        )                                                   # [src, e_loc]
+        seg_experts = jnp.tile(jnp.arange(e_loc), ep)       # [src*e_loc]
+        row_expert = jnp.repeat(
+            seg_experts, my_counts.reshape(-1),
+            total_repeat_length=cap_rows,
+        )
+        n_recv = my_counts.sum()
+        # Padding rows past n_recv got arbitrary repeat values; force
+        # them to the sentinel group so they sort to the end.
+        row_expert = jnp.where(
+            jnp.arange(cap_rows) < n_recv, row_expert, e_loc
+        )
+        order2 = jnp.argsort(row_expert, stable=True)
+        inv2 = jnp.argsort(order2)
+        xs2 = _permute_rows(recv, order2, inv2)
+        group_sizes = jnp.bincount(
+            row_expert, length=e_loc + 1
+        ).astype(jnp.int32)
+        # gmm groups must cover all rows: fold the pad tail (zero rows,
+        # zero outputs regardless of expert) into the last real group.
+        group_sizes = (
+            group_sizes[:e_loc].at[e_loc - 1].add(group_sizes[e_loc])
+        )
+
+        w_gu = jnp.concatenate([wg.astype(cdt), wu.astype(cdt)], -1)
+        hu = gmm(
+            xs2, w_gu, group_sizes, interpret=interpret,
+            tiling=(_tile(cap_rows), _tile(d), _tile(2 * f)),
+        )
+        a = (jax.nn.silu(hu[:, :f]) * hu[:, f:]).astype(cdt)
+        ys2 = gmm(
+            a, wd.astype(cdt), group_sizes, interpret=interpret,
+            tiling=(_tile(cap_rows), _tile(f), _tile(d)),
+        ).astype(cdt)
+
+        # Unsort to (src, expert)-major and ship results home.
+        ys = _permute_rows(ys2, inv2, order2)
+        back = _exchange(ys, sizes_mat, me, ep, axis_name, reverse=True)
+
+        # Home layout equals the original sorted xs rows; unsort and
+        # combine the k copies per token with a dense reshape-sum.
+        out_tok = _permute_rows(back[:m_loc], inv_order, order)
+        out = jnp.sum(
+            out_tok.reshape(n_loc, top_k, d).astype(jnp.float32)
+            * gates[:, :, None],
+            axis=1,
+        )
+        return out.astype(x.dtype).reshape(bl, sl, d)
+
+    wspec = P(axis_name)
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(xspec, P(), wspec, wspec, wspec),
+        out_specs=xspec,
+        check_rep=False,
+    )(x, router_w, w_gate, w_up, w_down)
+    out = with_logical_constraint(out, ("batch", "seq", "embed"))
+
     metrics = MoEMetrics(
         aux_loss=aux,
         router_z_loss=z,

@@ -92,18 +92,95 @@ def test_gshard_at_infinite_capacity_matches_dropless():
     )
 
 
-def test_model_auto_selects_dropless_without_ep():
+def test_model_moe_impl_resolution():
+    """auto follows the measured crossover: gshard at the default
+    capacity factor, dropless at capacity >= 2.0 on a single device
+    (ADVICE r3: the global-argsort core must never see a GSPMD-sharded
+    batch); explicit dropless maps to the mesh-appropriate variant."""
     cfg = llama.tiny_config(n_experts=4)
-    assert llama._moe_use_dropless(cfg)  # no mesh
+    assert llama._moe_resolve_impl(cfg) == "gshard"  # cap 1.25 default
+    hi_cap = llama.tiny_config(n_experts=4, capacity_factor=2.0)
+    assert llama._moe_resolve_impl(hi_cap) == "dropless"  # no mesh
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 
     with build_mesh(MeshConfig(ep=2, dp=4)):
-        assert not llama._moe_use_dropless(cfg)
+        assert llama._moe_resolve_impl(cfg) == "gshard"
+        assert llama._moe_resolve_impl(hi_cap) == "gshard"
     with build_mesh(MeshConfig(dp=8)):
-        assert llama._moe_use_dropless(cfg)
-    assert not llama._moe_use_dropless(
+        assert llama._moe_resolve_impl(cfg) == "gshard"
+    exp = llama.tiny_config(n_experts=4, moe_impl="dropless")
+    with build_mesh(MeshConfig(ep=2, dp=4)):
+        assert llama._moe_resolve_impl(exp) == "dropless_ep"
+    with build_mesh(MeshConfig(dp=8)):
+        assert llama._moe_resolve_impl(exp) == "dropless_sharded"
+    assert llama._moe_resolve_impl(exp) == "dropless"
+    assert llama._moe_resolve_impl(
         llama.tiny_config(n_experts=4, moe_impl="gshard")
+    ) == "gshard"
+
+
+def test_dropless_ep_matches_dense_reference():
+    """The ragged-all-to-all expert-parallel dropless path computes the
+    same mixture as the dense reference, on a real ep mesh."""
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    x = jax.random.normal(jax.random.key(6), (8, 8, 16), jnp.float32)
+    router, wg, wu, wd = _weights(jax.random.key(7))
+    ref = _dense_reference(x, router, wg, wu, wd, 2)
+    mesh = build_mesh(MeshConfig(dp=2, ep=4))
+    with mesh:
+        out, metrics = jax.jit(
+            lambda x: moe_lib.moe_mlp_dropless_ep(
+                x, router, wg, wu, wd, mesh, top_k=2
+            )
+        )(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
     )
+    assert float(metrics.dropped_fraction) == 0.0
+
+
+def test_dropless_sharded_matches_dense_reference():
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    x = jax.random.normal(jax.random.key(8), (8, 6, 16), jnp.float32)
+    router, wg, wu, wd = _weights(jax.random.key(9))
+    ref = _dense_reference(x, router, wg, wu, wd, 2)
+    mesh = build_mesh(MeshConfig(dp=8))
+    with mesh:
+        out, _ = jax.jit(
+            lambda x: moe_lib.moe_mlp_dropless_sharded(
+                x, router, wg, wu, wd, mesh, top_k=2
+            )
+        )(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_moe_model_trains_dropless_ep_mesh():
+    """Full model training with moe_impl=dropless on an ep mesh: the
+    dropless property survives expert parallelism (VERDICT r3 #3)."""
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer import train_step as ts
+
+    mesh = build_mesh(MeshConfig(ep=2, dp=4))
+    cfg = llama.tiny_config(
+        n_layers=2, n_experts=4, moe_impl="dropless"
+    )
+    tc = ts.TrainConfig(learning_rate=5e-3, warmup_steps=2)
+    opt = ts.make_optimizer(tc)
+    state, _ = ts.init_train_state(cfg, opt, mesh, jax.random.key(0))
+    step, _ = ts.make_train_step(cfg, tc, opt, mesh)
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 33), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, {"tokens": tokens})
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.2, losses
 
 
 def test_moe_model_trains_dropless():
