@@ -64,11 +64,81 @@ def init_cache(
     )
 
 
+def _decode_attn_impl() -> str:
+    """"pallas" | "xla" for the single-token decode step's attention.
+
+    Auto is XLA: the length-aware Pallas kernel
+    (ops/decode_attention.py) reads only the filled cache blocks, but
+    its (batch, kv_head, block) grid runs SEQUENTIALLY on TPU — at the
+    flagship decode shape the serialization costs more than the padded
+    reads it saves (measured v5e b=8: 3.77 vs 2.24 ms/token; the bench
+    A/B keeps both on record). DLROVER_TPU_DECODE_ATTN=pallas opts in
+    (wins would need batch*kv_heads small or caches much longer than
+    the fill)."""
+    import os
+
+    raw = os.environ.get("DLROVER_TPU_DECODE_ATTN", "auto").lower()
+    if raw in ("pallas", "xla"):
+        return raw
+    return "xla"
+
+
+def _fuse_decode_params(config, layers):
+    """Concatenate the per-layer projection weights the decode loop
+    multiplies back to back: wq|wk|wv -> one [d, h+2kh, hd] matmul and
+    w_gate|w_up -> one [d, 2f] matmul (dense configs). Decode is
+    op-count-bound (each step is ~160 small dispatches), so halving the
+    projection matmuls is a direct ms/token win; the math is identical.
+    Leaves are stacked [L, ...]."""
+    if config.n_experts > 0:
+        return layers
+    fused = dict(layers)
+    fused["wqkv"] = jnp.concatenate(
+        [layers["wq"], layers["wk"], layers["wv"]], axis=2
+    )  # [L, d, h + 2*kh, hd]
+    fused["w_gu"] = jnp.concatenate(
+        [layers["w_gate"], layers["w_up"]], axis=2
+    )  # [L, d, 2f]
+    for k in ("wq", "wk", "wv", "w_gate", "w_up"):
+        del fused[k]
+    return fused
+
+
+def _fused_qkv(config, p, x, positions):
+    """attention_qkv over the concatenated projection (decode path)."""
+    cdt = config.compute_dtype
+    hx = llama.rms_norm(x, p["attn_norm"]).astype(cdt)
+    qkv = jnp.einsum("bsd,dhk->bshk", hx, p["wqkv"].astype(cdt))
+    h, kh = config.n_heads, config.n_kv_heads
+    q, k, v = (
+        qkv[:, :, :h],
+        qkv[:, :, h:h + kh],
+        qkv[:, :, h + kh:],
+    )
+    q = llama.apply_rope(q, positions, config.rope_theta)
+    k = llama.apply_rope(k, positions, config.rope_theta)
+    return q, k, v
+
+
+def _fused_mlp(config, p, x):
+    cdt = config.compute_dtype
+    residual = x
+    hx = llama.rms_norm(x, p["mlp_norm"]).astype(cdt)
+    f = config.mlp_dim
+    gu = jnp.einsum("bsd,df->bsf", hx, p["w_gu"].astype(cdt))
+    a = jax.nn.silu(gu[..., :f]) * gu[..., f:]
+    out = jnp.einsum("bsf,fd->bsd", a, p["w_down"].astype(cdt))
+    return residual + out.astype(residual.dtype)
+
+
 def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
     """One decoder block over [b, sq] new tokens with cache append.
     Returns (x, new_k_cache, new_v_cache)."""
     residual = x
-    q, k, v = llama.attention_qkv(config, p, x, positions)
+    if "wqkv" in p:
+        q, k, v = _fused_qkv(config, p, x, positions)
+    else:
+        q, k, v = llama.attention_qkv(config, p, x, positions)
     # Append the new tokens' K/V at the cache cursor.
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
@@ -76,20 +146,46 @@ def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
     )
-    # Attention over the full pre-allocated cache: with contiguous query
-    # positions (max q_pos == new length - 1), the causal mask already
-    # excludes every unfilled slot.
     max_len = k_cache.shape[1]
-    attn = dot_product_attention(
-        q,
-        k_cache,
-        v_cache,
-        causal=True,
-        q_positions=positions,
-        kv_positions=jnp.arange(max_len),
+    block_k = next(
+        (c for c in (128, 64, 32, 16) if max_len % c == 0), None
     )
+    if (
+        q.shape[1] == 1
+        and block_k is not None
+        and _decode_attn_impl() == "pallas"
+    ):
+        # Single-token step: the length-aware kernel reads only the
+        # filled cache blocks (ops/decode_attention.py).
+        from dlrover_tpu.ops.decode_attention import decode_attention
+
+        attn = decode_attention(
+            q[:, 0], k_cache, v_cache, cache_len + 1, block_k=block_k
+        )[:, None]
+    else:
+        # Plain attention over the full pre-allocated cache; with
+        # contiguous query positions the causal mask already excludes
+        # every unfilled slot. Two length-aware alternatives were
+        # measured and REJECTED on v5e (b=8, 334M): the Pallas kernel
+        # above (sequential grid, 3.8 vs 2.3 ms/token — opt-in only)
+        # and lax.switch-bucketed static prefixes (no gain at b>=8, and
+        # the per-layer branch dispatch cost b=1 0.92 -> 1.39 ms/token)
+        # — the padded reads are NOT the decode bottleneck; per-step
+        # dispatch overhead of the ~160-op layer graph is (see
+        # decode_vs_roofline in the bench).
+        attn = dot_product_attention(
+            q,
+            k_cache,
+            v_cache,
+            causal=True,
+            q_positions=positions,
+            kv_positions=jnp.arange(max_len),
+        )
     x = llama.attention_out(config, p, attn, residual)
-    x, _ = llama.mlp_block(config, p, x)
+    if "w_gu" in p:
+        x = _fused_mlp(config, p, x)
+    else:
+        x, _ = llama.mlp_block(config, p, x)
     return x, k_cache, v_cache
 
 
@@ -160,6 +256,10 @@ def _compiled_generate(
                 "final_norm": params["final_norm"],
                 "lm_head": params["lm_head"].astype(cdt),
             }
+        params = {
+            **params,
+            "layers": _fuse_decode_params(config, params["layers"]),
+        }
         cache = init_cache(config, batch, max_len)
         logits, cache = _forward_with_cache(config, params, prompt, cache)
         rng, first_key = jax.random.split(rng)

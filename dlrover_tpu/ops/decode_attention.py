@@ -1,0 +1,149 @@
+"""Single-query (decode-step) attention over a KV cache, Pallas.
+
+The generate loop's per-step attention previously ran the plain XLA
+``dot_product_attention`` over the FULL pre-allocated cache — every
+step reads ``max_len`` KV rows even when only ``length`` are filled,
+and the masked softmax touches the padding too. Decode is HBM-bound,
+so those wasted reads are wasted milliseconds.
+
+This kernel is length-aware: the fill length rides as a scalar-prefetch
+argument, the KV block index map CLAMPS past-the-end blocks to the last
+valid block (Mosaic skips the HBM copy when a block index repeats), and
+``pl.when`` skips their compute. Per (batch, kv-head) grid cell the
+query group (GQA: n_heads // n_kv_heads rows, padded to the 8-sublane
+minimum) runs an online-softmax sweep over KV blocks — flash attention
+with a 1-token query.
+
+Parity note: the reference delegates decode to vLLM/torch kernels
+(paged attention); this is the TPU-native analogue for this repo's
+single-slab cache.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_k: int, scale: float,
+):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[0]
+    base = j * block_k
+
+    @pl.when(base < length)
+    def _():
+        q = q_ref[0, 0]                                 # [gp, d]
+        k = k_ref[0]                                    # [bk, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [gp, bk]
+        cols = base + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * corr + jnp.sum(p, -1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q,            # [b, n_heads, d] — ONE query token per sequence
+    k_cache,      # [b, max_len, kv_heads, d]
+    v_cache,
+    length,       # [] int32 — filled cache rows (uniform over batch)
+    block_k: int = 128,
+    interpret=None,
+):
+    """Length-masked single-query attention; returns [b, n_heads, d]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    _, max_len, kh, _ = k_cache.shape
+    if h % kh:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kh}")
+    g = h // kh
+    gp = max(g, 8)  # sublane minimum
+    scale = d ** -0.5
+    # [b, kh, gp, d] query groups, zero-padded rows.
+    qg = q.reshape(b, kh, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    length = jnp.asarray(length, jnp.int32).reshape(1)
+    nj = max_len // block_k
+    if max_len % block_k:
+        raise ValueError(
+            f"max_len {max_len} not a multiple of block_k {block_k}"
+        )
+
+    def kv_index(ib, ih, j, len_ref):
+        # Clamp past-the-fill blocks to the last valid one: Mosaic skips
+        # the HBM copy when the index repeats, so unfilled cache rows
+        # are never read.
+        last = jnp.maximum((len_ref[0] - 1) // block_k, 0)
+        return (ib, jnp.minimum(j, last), ih)
+
+    # Mosaic wants the trailing two block dims (8, 128)-divisible: view
+    # the cache [b, L, kh, d] as [b, L, kh*d] (free — contiguous) and
+    # block the lane dim per kv head.
+    kf = k_cache.reshape(b, max_len, kh * d)
+    vf = v_cache.reshape(b, max_len, kh * d)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, kh, nj),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, gp, d), lambda ib, ih, j, s: (ib, ih, 0, 0)
+                ),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_k, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, d), lambda ib, ih, j, s: (ib, ih, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), q.dtype),
+        interpret=interpret,
+    )(length, qg, kf, vf)
+    return out[:, :, :g, :].reshape(b, h, d)

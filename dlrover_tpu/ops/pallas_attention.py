@@ -92,9 +92,14 @@ def _flash_kernel(
         l_prev = l_ref[:, :1]
         m_blk = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
-        # No row is ever fully masked here: causal grids skip whole
-        # future tiles, and within a diagonal tile row r always has at
-        # least column r valid — so exp needs no -inf guard pass.
+        # Fully-masked ROWS can exist in a diagonal tile when
+        # block_q > block_k (rows q_start..k_start-1 see only future
+        # columns). The invariant that makes this safe without a -inf
+        # guard: the FIRST k-tile of every row's sweep contributes at
+        # least one valid column (k_start=0 <= row), so m_prev is
+        # finite by the time any fully-masked tile-row is processed,
+        # and its exp(NEG_INF - m_new) underflows to exactly 0. Keep
+        # that ordering (ki=0 first) if the grid or NEG_INF changes.
         p = jnp.exp(logits - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)

@@ -106,3 +106,29 @@ def test_sampling_requires_rng():
         gen.generate(
             cfg, params, jnp.zeros((1, 2), jnp.int32), 2, temperature=1.0
         )
+
+
+def test_decode_attn_pallas_matches_xla(monkeypatch):
+    """The length-aware Pallas decode attention (interpret mode on CPU)
+    must produce the same tokens as the XLA padded-cache path."""
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.models.generate import _compiled_generate, generate
+
+    cfg = llama.tiny_config(n_layers=2)
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(
+        jax.random.key(1), (2, 7), 0, cfg.vocab_size
+    )
+
+    monkeypatch.setenv("DLROVER_TPU_DECODE_ATTN", "xla")
+    _compiled_generate.cache_clear()
+    ref = generate(cfg, params, prompt, max_new_tokens=9, max_len=16)
+
+    monkeypatch.setenv("DLROVER_TPU_DECODE_ATTN", "pallas")
+    _compiled_generate.cache_clear()
+    got = generate(cfg, params, prompt, max_new_tokens=9, max_len=16)
+    _compiled_generate.cache_clear()
+
+    assert (got.tokens == ref.tokens).all(), (got.tokens, ref.tokens)
