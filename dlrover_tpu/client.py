@@ -1,0 +1,120 @@
+"""Out-of-cluster job submission client.
+
+Parity: reference dlrover/python/client/platform/ray/ray_job_submitter.py
+:1-185 — the thin library users call from OUTSIDE the cluster to submit
+a job and watch it. The reference submits to Ray's job server; here the
+cluster entry is the token-authenticated HTTP submission service
+(:mod:`dlrover_tpu.unified.submission`, typically run next to the
+operator or on the head node).
+
+Usage::
+
+    from dlrover_tpu.client import JobSubmitter
+
+    sub = JobSubmitter("head-node:8910", token="...")
+    sub.submit({
+        "job_name": "ppo",
+        "roles": [{"name": "trainer", "entrypoint": "my.train",
+                   "total": 4, "per_group": 2}],
+    })
+    final = sub.wait("ppo")          # -> "SUCCEEDED" | "FAILED"
+
+The config dict is the same DLJobConfig JSON shape
+``python -m dlrover_tpu.unified.driver job.json`` reads; dataclass
+instances (DLJobConfig) are serialized automatically.
+"""
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Union
+
+TERMINAL_STAGES = ("SUCCEEDED", "FAILED")
+
+
+class SubmitError(RuntimeError):
+    pass
+
+
+def _to_payload(config: Union[dict, object]) -> dict:
+    if isinstance(config, dict):
+        return config
+    if dataclasses.is_dataclass(config):
+        return dataclasses.asdict(config)
+    raise TypeError(
+        f"config must be a dict or DLJobConfig, got {type(config)}"
+    )
+
+
+class JobSubmitter:
+    """HTTP client for the submission service (see module doc)."""
+
+    def __init__(self, address: str, token: str,
+                 timeout: float = 30.0):
+        if "://" not in address:
+            address = f"http://{address}"
+        self._base = address.rstrip("/")
+        self._token = token
+        self._timeout = timeout
+
+    def _call(self, method: str, path: str,
+              payload: Optional[dict] = None) -> dict:
+        body = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{self._base}{path}",
+            data=body,
+            method=method,
+            headers={
+                "X-Submit-Token": self._token,
+                "Content-Type": "application/json",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except (ValueError, OSError):
+                detail = ""
+            raise SubmitError(
+                f"{method} {path}: HTTP {e.code} {detail}".strip()
+            ) from None
+        except urllib.error.URLError as e:
+            raise SubmitError(
+                f"{method} {path}: {e.reason}"
+            ) from None
+
+    # ---- API ---------------------------------------------------------------
+
+    def submit(self, config: Union[dict, object]) -> str:
+        """Submit a job; returns its name (raises SubmitError on
+        rejection — bad config, duplicate running job, bad token)."""
+        rsp = self._call("POST", "/api/v1/jobs", _to_payload(config))
+        return rsp["job_name"]
+
+    def status(self, job_name: str) -> Dict[str, str]:
+        """{"job_name", "stage", "error"} for one job."""
+        return self._call("GET", f"/api/v1/jobs/{job_name}")
+
+    def list_jobs(self) -> Dict[str, str]:
+        return self._call("GET", "/api/v1/jobs")["jobs"]
+
+    def stop(self, job_name: str) -> Dict[str, str]:
+        return self._call("POST", f"/api/v1/jobs/{job_name}/stop")
+
+    def wait(self, job_name: str, timeout: float = 600.0,
+             poll_s: float = 1.0) -> str:
+        """Poll until the job reaches a terminal stage; returns it."""
+        deadline = time.time() + timeout
+        while True:
+            stage = self.status(job_name)["stage"]
+            if stage in TERMINAL_STAGES:
+                return stage
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"job {job_name!r} still {stage} after {timeout}s"
+                )
+            time.sleep(poll_s)

@@ -1,0 +1,224 @@
+"""In-cluster job-submission service: accept jobs over HTTP.
+
+Parity: the receiving end of the reference's out-of-cluster submission
+path (dlrover/python/client/platform/ray/ray_job_submitter.py:1-185
+submits to Ray's job server; here the cluster entry is this small
+token-authenticated HTTP service, typically run next to the operator or
+on the head node):
+
+    python -m dlrover_tpu.unified.submission --port 8910
+
+Endpoints (JSON in/out, ``X-Submit-Token`` header required):
+
+- ``POST /api/v1/jobs``           body = DLJobConfig JSON (the same
+  shape ``unified/driver.py`` reads from a file) -> ``{"job_name"}``
+- ``GET  /api/v1/jobs``           -> ``{"jobs": {name: stage}}``
+- ``GET  /api/v1/jobs/<name>``    -> ``{"job_name", "stage", "error"}``
+- ``POST /api/v1/jobs/<name>/stop`` -> ``{"job_name", "stage"}``
+
+Each accepted job runs through :func:`unified.master.submit`
+(non-blocking) — the same PrimeManager path the in-cluster driver uses.
+The client side lives in :mod:`dlrover_tpu.client`.
+"""
+
+import argparse
+import hmac
+import json
+import os
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import logger
+
+SUBMIT_TOKEN_ENV = "DLROVER_TPU_SUBMIT_TOKEN"
+_MAX_BODY = 4 << 20  # a job config, not a dataset
+
+
+class _JobRecord:
+    def __init__(self, master=None):
+        self.master = master  # None while submit() is still starting it
+        self.error = ""
+
+    def stage(self) -> str:
+        if self.master is None:
+            return "INIT" if not self.error else "FAILED"
+        try:
+            stage = self.master.status()
+        except Exception as e:  # noqa: BLE001 - status must not 500
+            return f"UNKNOWN({type(e).__name__}: {e})"
+        if stage == "FAILED" and not self.error:
+            self.error = "job ended in FAILED (see master/worker logs)"
+        return stage
+
+
+class SubmissionServer:
+    """Threaded HTTP server owning the submitted jobs' PrimeMasters."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
+        self._token = (
+            token or os.getenv(SUBMIT_TOKEN_ENV) or secrets.token_hex(16)
+        )
+        self._jobs: Dict[str, _JobRecord] = {}
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("submission: " + fmt, *args)
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authorized(self) -> bool:
+                got = self.headers.get("X-Submit-Token", "")
+                return hmac.compare_digest(got, server._token)
+
+            def do_GET(self):
+                if not self._authorized():
+                    return self._reply(403, {"error": "bad token"})
+                parts = self.path.strip("/").split("/")
+                if parts[:3] == ["api", "v1", "jobs"]:
+                    if len(parts) == 3:
+                        return self._reply(200, {"jobs": server.jobs()})
+                    rec = server.job(parts[3])
+                    if rec is None:
+                        return self._reply(
+                            404, {"error": f"no job {parts[3]!r}"}
+                        )
+                    return self._reply(200, {
+                        "job_name": parts[3],
+                        "stage": rec.stage(),
+                        "error": rec.error,
+                    })
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if not self._authorized():
+                    return self._reply(403, {"error": "bad token"})
+                parts = self.path.strip("/").split("/")
+                if parts[:3] != ["api", "v1", "jobs"]:
+                    return self._reply(404, {"error": "unknown path"})
+                if len(parts) == 5 and parts[4] == "stop":
+                    rec = server.job(parts[3])
+                    if rec is None:
+                        return self._reply(
+                            404, {"error": f"no job {parts[3]!r}"}
+                        )
+                    if rec.master is None:
+                        return self._reply(409, {
+                            "error": f"job {parts[3]!r} still starting",
+                        })
+                    rec.master.stop()
+                    return self._reply(200, {
+                        "job_name": parts[3], "stage": rec.stage(),
+                    })
+                if len(parts) != 3:
+                    return self._reply(404, {"error": "unknown path"})
+                size = int(self.headers.get("Content-Length", "0"))
+                if size > _MAX_BODY:
+                    return self._reply(413, {"error": "config too large"})
+                try:
+                    payload = json.loads(self.rfile.read(size))
+                except (ValueError, OSError) as e:
+                    return self._reply(
+                        400, {"error": f"bad JSON: {e}"}
+                    )
+                try:
+                    name = server.submit(payload)
+                except Exception as e:  # noqa: BLE001 - surface to caller
+                    return self._reply(400, {
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                return self._reply(200, {"job_name": name})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dlrover-tpu-submission",
+        )
+        self._thread.start()
+        self.port = self._httpd.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        logger.info("submission service on %s", self.addr)
+
+    @property
+    def token(self) -> str:
+        return self._token
+
+    # ---- job registry -----------------------------------------------------
+
+    def submit(self, payload: dict) -> str:
+        from dlrover_tpu.unified.driver import config_from_json
+        from dlrover_tpu.unified.manager import JobStage
+        from dlrover_tpu.unified.master import submit as run_job
+
+        config = config_from_json(payload)
+        config.validate()
+        # Reserve the name under the lock, start the job OUTSIDE it —
+        # master startup can take seconds and must not block concurrent
+        # status/list/stop requests.
+        rec = _JobRecord()
+        with self._lock:
+            existing = self._jobs.get(config.job_name)
+            if existing is not None and existing.stage() not in (
+                JobStage.SUCCEEDED, JobStage.FAILED,
+            ):
+                raise ValueError(
+                    f"job {config.job_name!r} is already running"
+                )
+            self._jobs[config.job_name] = rec
+        try:
+            rec.master = run_job(config, blocking=False)
+        except Exception as e:
+            rec.error = f"{type(e).__name__}: {e}"
+            raise
+        logger.info("accepted job %s", config.job_name)
+        return config.job_name
+
+    def jobs(self) -> Dict[str, str]:
+        with self._lock:
+            return {n: r.stage() for n, r in self._jobs.items()}
+
+    def job(self, name: str) -> Optional[_JobRecord]:
+        with self._lock:
+            return self._jobs.get(name)
+
+    def close(self):
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for rec in jobs:
+            try:
+                if rec.master is not None:
+                    rec.master.stop()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8910)
+    ns = ap.parse_args(argv)
+    server = SubmissionServer(host=ns.host, port=ns.port)
+    if not os.getenv(SUBMIT_TOKEN_ENV):
+        logger.info("generated submit token: %s", server.token)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

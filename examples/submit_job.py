@@ -1,0 +1,74 @@
+"""Out-of-cluster job submission.
+
+Run the cluster entry (anywhere with cluster access — here, this same
+host) and submit a job to it from a separate client process:
+
+    # terminal 1 (cluster side)
+    DLROVER_TPU_SUBMIT_TOKEN=demo python -m dlrover_tpu.unified.submission \
+        --host 127.0.0.1 --port 8910
+
+    # terminal 2 (client side — what this script does)
+    python examples/submit_job.py 127.0.0.1:8910 demo
+
+Parity: reference dlrover/python/client/platform/ray/ray_job_submitter.py
+usage — build a config, submit, poll to completion. When run with no
+arguments, this script starts an in-process SubmissionServer first so
+the demo is self-contained.
+"""
+
+import os
+import sys
+import tempfile
+
+from dlrover_tpu.client import JobSubmitter
+
+_WORKER = (
+    "import os\n"
+    "from dlrover_tpu.unified import runtime\n"
+    "me = runtime.current_worker()\n"
+    "print(f'[{me.role}:{me.rank}] hello from the submitted job')\n"
+)
+
+
+def _job_config() -> dict:
+    workdir = tempfile.mkdtemp(prefix="dlrover_tpu_submit_demo_")
+    with open(os.path.join(workdir, "demo_worker.py"), "w") as f:
+        f.write(_WORKER)
+    pythonpath = f"{workdir}:{os.environ.get('PYTHONPATH', '')}"
+    return {
+        "job_name": "submit-demo",
+        "roles": [
+            {
+                "name": "trainer",
+                "entrypoint": "demo_worker",
+                "total": 2,
+                "per_group": 1,
+                "envs": {"PYTHONPATH": pythonpath},
+            }
+        ],
+    }
+
+
+def main():
+    if len(sys.argv) >= 3:
+        addr, token = sys.argv[1], sys.argv[2]
+        server = None
+    else:
+        from dlrover_tpu.unified.submission import SubmissionServer
+
+        server = SubmissionServer()
+        addr, token = server.addr, server.token
+        print(f"started in-process submission service on {addr}")
+
+    sub = JobSubmitter(addr, token=token)
+    name = sub.submit(_job_config())
+    print(f"submitted {name}; jobs: {sub.list_jobs()}")
+    final = sub.wait(name, timeout=300.0)
+    print(f"job {name} finished: {final}")
+    if server is not None:
+        server.close()
+    return 0 if final == "SUCCEEDED" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

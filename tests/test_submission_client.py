@@ -1,0 +1,106 @@
+"""Out-of-cluster submission: client -> HTTP service -> unified job.
+
+Parity: reference client/platform/ray/ray_job_submitter.py (submit a
+job config from outside the cluster, poll it to completion).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dlrover_tpu.client import JobSubmitter, SubmitError
+from dlrover_tpu.unified.submission import SubmissionServer
+
+_OK_SCRIPT = (
+    "import os,time; time.sleep(0.2); "
+    "open(os.environ['OUT'] + '.' + os.environ['DLROVER_TPU_ROLE'] + "
+    "os.environ['DLROVER_TPU_ROLE_RANK'], 'w').write('done')"
+)
+
+
+@pytest.fixture
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_RUNTIME_DIR", str(tmp_path / "rt"))
+    srv = SubmissionServer()
+    yield srv
+    srv.close()
+
+
+def _job_config(tmp_path, name="subtest"):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir(exist_ok=True)
+    (mod_dir / "okworker.py").write_text(_OK_SCRIPT)
+    return {
+        "job_name": name,
+        "roles": [
+            {
+                "name": "trainer",
+                "entrypoint": "okworker",
+                "total": 2,
+                "per_group": 1,
+                "envs": {
+                    "OUT": str(tmp_path / "out"),
+                    "PYTHONPATH": f"{mod_dir}:{os.environ.get('PYTHONPATH', '')}",
+                },
+            }
+        ],
+    }
+
+
+def test_submit_poll_and_complete(server, tmp_path):
+    sub = JobSubmitter(server.addr, token=server.token)
+    name = sub.submit(_job_config(tmp_path))
+    assert name == "subtest"
+    assert "subtest" in sub.list_jobs()
+    final = sub.wait(name, timeout=60.0, poll_s=0.2)
+    assert final == "SUCCEEDED"
+    assert (tmp_path / "out.trainer0").exists()
+    assert (tmp_path / "out.trainer1").exists()
+    # Re-submitting a finished job name is allowed (rerun)...
+    assert sub.submit(_job_config(tmp_path)) == "subtest"
+    assert sub.wait(name, timeout=60.0, poll_s=0.2) == "SUCCEEDED"
+
+
+def test_bad_token_and_bad_config_rejected(server, tmp_path):
+    bad = JobSubmitter(server.addr, token="wrong")
+    with pytest.raises(SubmitError, match="403"):
+        bad.submit(_job_config(tmp_path))
+    with pytest.raises(SubmitError, match="403"):
+        bad.list_jobs()
+
+    good = JobSubmitter(server.addr, token=server.token)
+    with pytest.raises(SubmitError, match="entrypoint"):
+        good.submit({"job_name": "x",
+                     "roles": [{"name": "r", "entrypoint": ""}]})
+    with pytest.raises(SubmitError, match="404"):
+        good.status("ghost")
+
+
+def test_submit_from_separate_process(server, tmp_path):
+    """The reference's actual usage: the submitting client is a
+    different process from the cluster entry."""
+    cfg = _job_config(tmp_path, name="xproc")
+    script = (
+        "import json, sys\n"
+        "from dlrover_tpu.client import JobSubmitter\n"
+        "addr, token, cfg = sys.argv[1], sys.argv[2], "
+        "json.loads(sys.argv[3])\n"
+        "sub = JobSubmitter(addr, token=token)\n"
+        "name = sub.submit(cfg)\n"
+        "print(sub.wait(name, timeout=60.0, poll_s=0.2))\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        "/root/repo:" + env.get("PYTHONPATH", "")
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script, server.addr, server.token,
+         json.dumps(cfg)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().endswith("SUCCEEDED")
+    assert (tmp_path / "out.trainer0").exists()
