@@ -15,12 +15,21 @@ over this repo's artifacts:
   MXU-shaped GEMMs on the local device and report achieved TFLOP/s and
   efficiency vs peak — the host-qualification table the reference's
   matmul analysis produces for GPUs.
+- ``merge``: cross-rank timeline merge (reference
+  py_xpu_timer/parse_perfetto.py + gen_trace_timeline.py) — align N
+  ranks' chrome traces onto one clock (offsets estimated from matched
+  collective END times, which a blocking collective makes simultaneous
+  across ranks up to skew), emit a single multi-process trace, and
+  flag the STRAGGLER rank per collective (the rank arriving last is
+  the one everyone else waited for).
 
 Usage::
 
     python -m dlrover_tpu.tpu_timer.analysis timeline trace.json
     python -m dlrover_tpu.tpu_timer.analysis stacks worker-*.log
     python -m dlrover_tpu.tpu_timer.analysis matmul --sizes 2048,4096
+    python -m dlrover_tpu.tpu_timer.analysis merge rank0.json rank1.json \
+        --out merged.json
 """
 
 import argparse
@@ -180,6 +189,122 @@ def top_frames(stacks: Iterable[List[str]], k: int = 10) -> List[Tuple[str, int]
 
 
 # ---------------------------------------------------------------------------
+# Cross-rank timeline merge + straggler attribution
+# ---------------------------------------------------------------------------
+
+_COLL_RE = re.compile(
+    r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|ppermute"
+    r"|all[-_]?to[-_]?all|collective",
+    re.IGNORECASE,
+)
+
+
+def _collective_spans(trace: dict) -> Dict[str, List[Tuple[float, float]]]:
+    """name -> [(start, end)] in ts order, for collective-looking device
+    events."""
+    out: Dict[str, List[Tuple[float, float]]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        if name.startswith("xla/") and _COLL_RE.search(name):
+            ts = float(e.get("ts", 0.0))
+            out.setdefault(name, []).append((ts, ts + float(e.get("dur", 0.0))))
+    for spans in out.values():
+        spans.sort()
+    return out
+
+
+def estimate_clock_offsets(
+    traces: Dict[int, dict]
+) -> Dict[int, float]:
+    """Per-rank clock offset (us, subtract to land on rank-0's clock).
+
+    A blocking collective ENDS on every participant at (nearly) the
+    same wall instant — the k-th instance of a given collective name is
+    the same logical operation on every rank, so the median difference
+    of its end times vs rank 0 estimates the clock skew. Host clocks in
+    one job are NTP-close but not trace-identical; without this the
+    merged timeline misattributes waits to whichever host booted last.
+    """
+    ranks = sorted(traces)
+    base = _collective_spans(traces[ranks[0]])
+    offsets = {ranks[0]: 0.0}
+    for r in ranks[1:]:
+        mine = _collective_spans(traces[r])
+        diffs: List[float] = []
+        for name, spans0 in base.items():
+            spans_r = mine.get(name, [])
+            for k in range(min(len(spans0), len(spans_r))):
+                diffs.append(spans_r[k][1] - spans0[k][1])
+        diffs.sort()
+        offsets[r] = diffs[len(diffs) // 2] if diffs else 0.0
+    return offsets
+
+
+def merge_rank_traces(traces: Dict[int, dict]) -> Tuple[dict, dict]:
+    """(merged chrome trace, straggler report) from per-rank traces.
+
+    The merged trace keeps every event with pid=rank (plus
+    process_name metadata rows), all on rank-0's clock. The report
+    gives, per collective name: mean/max arrival spread (latest start −
+    earliest start ≈ time the fast ranks wasted waiting) and how often
+    each rank was the last to arrive."""
+    offsets = estimate_clock_offsets(traces)
+    merged_events: List[dict] = []
+    for r, trace in sorted(traces.items()):
+        merged_events.append({
+            "ph": "M", "pid": r, "name": "process_name",
+            "args": {"name": f"rank {r}"},
+        })
+        off = offsets[r]
+        for e in trace.get("traceEvents", []):
+            e2 = dict(e)
+            e2["pid"] = r
+            if "ts" in e2:
+                e2["ts"] = float(e2["ts"]) - off
+            merged_events.append(e2)
+
+    # Straggler attribution over matched collective instances.
+    spans = {
+        r: _collective_spans(t) for r, t in traces.items()
+    }
+    report: Dict[str, dict] = {}
+    names = set().union(*(s.keys() for s in spans.values())) if spans else set()
+    for name in sorted(names):
+        per_rank = {
+            r: s.get(name, []) for r, s in spans.items()
+        }
+        n_inst = min((len(v) for v in per_rank.values()), default=0)
+        if n_inst == 0 or len(per_rank) < 2:
+            continue
+        spreads: List[float] = []
+        last_count: collections.Counter = collections.Counter()
+        for k in range(n_inst):
+            starts = {
+                r: per_rank[r][k][0] - offsets[r] for r in per_rank
+            }
+            latest = max(starts, key=starts.get)
+            spreads.append(starts[latest] - min(starts.values()))
+            last_count[latest] += 1
+        straggler, times = last_count.most_common(1)[0]
+        report[name] = {
+            "instances": n_inst,
+            "mean_wait_us": round(sum(spreads) / len(spreads), 1),
+            "max_wait_us": round(max(spreads), 1),
+            "straggler_rank": straggler,
+            "straggler_share": round(times / n_inst, 3),
+            "last_arrival_counts": dict(last_count),
+        }
+    return (
+        {"traceEvents": merged_events, "clock_offsets_us": {
+            str(r): round(v, 1) for r, v in offsets.items()
+        }},
+        report,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Matmul analysis
 # ---------------------------------------------------------------------------
 
@@ -266,6 +391,13 @@ def main(argv=None) -> int:
     p_mm.add_argument("--sizes", default="1024,2048,4096,8192")
     p_mm.add_argument("--iters", type=int, default=100)
 
+    p_mg = sub.add_parser(
+        "merge", help="merge N ranks' traces; flag stragglers"
+    )
+    p_mg.add_argument("traces", nargs="+",
+                      help="per-rank trace JSONs, rank = position")
+    p_mg.add_argument("--out", default="merged_trace.json")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "timeline":
@@ -297,6 +429,27 @@ def main(argv=None) -> int:
         sizes = [int(s) for s in args.sizes.split(",") if s]
         for row in matmul_analysis(sizes, args.iters):
             print(json.dumps(row))
+        return 0
+
+    if args.cmd == "merge":
+        traces = {}
+        for rank, path in enumerate(args.traces):
+            with open(path) as f:
+                traces[rank] = json.load(f)
+        merged, report = merge_rank_traces(traces)
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"merged {len(traces)} ranks -> {args.out} "
+              f"(offsets us: {merged['clock_offsets_us']})")
+        for name, row in sorted(
+            report.items(), key=lambda kv: -kv[1]["mean_wait_us"]
+        ):
+            print(
+                f"  {name}: straggler rank {row['straggler_rank']} "
+                f"({row['straggler_share']:.0%} of "
+                f"{row['instances']} instances), mean wait "
+                f"{row['mean_wait_us']}us max {row['max_wait_us']}us"
+            )
         return 0
     return 2
 

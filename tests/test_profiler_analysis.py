@@ -85,3 +85,83 @@ def test_matmul_analysis_runs_small():
     rows = matmul_analysis([64], iters=3)
     assert rows[0]["size"] == 64
     assert rows[0]["tflops"] > 0
+
+
+def _rigged_rank_trace(rank: int, clock_off: float, straggle: float):
+    """Synthetic chrome trace: 5 steps of matmul + all-reduce. Rank's
+    clock runs ``clock_off`` us ahead; its all-reduce arrives
+    ``straggle`` us late (it is the slow rank everyone waits for)."""
+    events = []
+    for k in range(5):
+        base = 10_000.0 * k + clock_off
+        events.append({
+            "ph": "X", "name": "xla/fusion.matmul",
+            "ts": base, "dur": 3000.0,
+        })
+        start = base + 3000.0 + straggle
+        # Collective END is the barrier: same wall instant on every
+        # rank (here: 9000 past the un-offset step base).
+        end = 10_000.0 * k + 9000.0 + clock_off
+        events.append({
+            "ph": "X", "name": "xla/all-reduce.1",
+            "ts": start, "dur": end - start,
+        })
+    return {"traceEvents": events}
+
+
+def test_merge_aligns_clocks_and_flags_straggler():
+    from dlrover_tpu.tpu_timer.analysis import (
+        estimate_clock_offsets,
+        merge_rank_traces,
+    )
+
+    traces = {
+        0: _rigged_rank_trace(0, clock_off=0.0, straggle=0.0),
+        1: _rigged_rank_trace(1, clock_off=2500.0, straggle=1200.0),
+    }
+    offsets = estimate_clock_offsets(traces)
+    assert offsets[0] == 0.0
+    assert abs(offsets[1] - 2500.0) < 1.0, offsets
+
+    merged, report = merge_rank_traces(traces)
+    # All events carry their rank as pid and sit on rank-0's clock.
+    pids = {e.get("pid") for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    r1_first_matmul = next(
+        e for e in merged["traceEvents"]
+        if e.get("pid") == 1 and e.get("name") == "xla/fusion.matmul"
+    )
+    assert abs(r1_first_matmul["ts"] - 0.0) < 1.0
+
+    row = report["xla/all-reduce.1"]
+    assert row["straggler_rank"] == 1
+    assert row["straggler_share"] == 1.0
+    assert abs(row["mean_wait_us"] - 1200.0) < 1.0
+    assert row["instances"] == 5
+
+
+def test_merge_cli_roundtrip(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    for r in (0, 1):
+        (tmp_path / f"rank{r}.json").write_text(json.dumps(
+            _rigged_rank_trace(r, clock_off=500.0 * r,
+                               straggle=300.0 * r)
+        ))
+    out = tmp_path / "merged.json"
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.tpu_timer.analysis",
+         "merge", str(tmp_path / "rank0.json"),
+         str(tmp_path / "rank1.json"), "--out", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert res.returncode == 0, res.stderr
+    assert "straggler rank 1" in res.stdout
+    merged = json.loads(out.read_text())
+    assert merged["clock_offsets_us"]["1"] == 500.0
