@@ -1,0 +1,56 @@
+"""README headline numbers must match the artifact they cite.
+
+Rounds 2 and 3 both shipped hand-transcribed numbers that drifted from
+the measured BENCH_r*.json; the claims block is now generated
+(tools/render_claims.py) and this test keeps it honest: every number in
+the block must re-derive from the bench artifact the block names.
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _block():
+    text = open(os.path.join(REPO, "README.md")).read()
+    m = re.search(
+        r"<!-- claims:begin -->\n(.*?)\n<!-- claims:end -->",
+        text, re.DOTALL,
+    )
+    assert m, "claims markers missing from README.md"
+    return m.group(1)
+
+
+def test_claims_block_matches_cited_artifact():
+    import render_claims
+
+    block = _block()
+    m = re.search(r"source: `(BENCH_r\d+\.json)`", block)
+    assert m, (
+        "claims block is unrendered — run python tools/render_claims.py"
+    )
+    cited = os.path.join(REPO, m.group(1))
+    assert os.path.exists(cited), f"cited artifact {cited} missing"
+    assert block.strip() == render_claims.render_block(cited).strip(), (
+        "README claims drift from the artifact they cite — run "
+        "python tools/render_claims.py"
+    )
+
+
+def test_no_stale_handwritten_metrics_outside_block():
+    """The prose outside the generated block must not carry MFU/recovery
+    numbers that can silently go stale."""
+    text = open(os.path.join(REPO, "README.md")).read()
+    prose = re.sub(
+        r"<!-- claims:begin -->.*?<!-- claims:end -->", "",
+        text, flags=re.DOTALL,
+    )
+    assert not re.search(r"\d+(\.\d+)?%\s*MFU", prose), (
+        "hand-written MFU claim outside the generated block"
+    )
+    assert not re.search(r"~?\d+(\.\d+)?\s*s\b.*recovery", prose), (
+        "hand-written recovery seconds outside the generated block"
+    )
