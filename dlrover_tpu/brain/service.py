@@ -7,13 +7,21 @@ runtime samples and job completions; ``optimize`` answers with a worker
 count learned from completed jobs of the same job name (the cross-job
 memory a single-job local optimizer cannot have).
 
-The optimizer is PLUGGABLE (the reference's processor/evaluator plugin
-architecture, scaled down): built-ins are selected with ``--optimizer``
-(``speedup`` — best cost-adjusted throughput; ``marginal-gain`` —
-largest worker count still scaling efficiently), and external
-algorithms load from a ``pkg.module:factory`` dotted path. The JSONL
-store self-compacts (record-count and age retention) so it no longer
-grows without bound.
+The reference's admin/processor/evaluator architecture is implemented,
+scaled to single-service size:
+
+- **datastore**: ``--store jsonl`` (self-compacting JSON-lines) or
+  ``--store sqlite`` (persistent DB with indexed job/time filtering —
+  the MySQL analogue). Both apply record-count and age retention.
+- **optimizer plugins**: ``--optimizer`` picks ``speedup`` (best
+  cost-adjusted throughput), ``marginal-gain`` (largest worker count
+  still scaling efficiently), or an external ``pkg.module:factory``.
+- **evaluators** (brain/evaluators.py): throughput-trend, straggler
+  dispersion, and OOM-risk assessments run by the OptimizeProcessor on
+  every ``/optimize`` and returned alongside the plan; pluggable the
+  same way via ``--evaluators``.
+- **admin**: GET ``/admin/jobs`` (known jobs + record counts),
+  ``/admin/store`` (backend + retention stats), ``/admin/evaluators``.
 
 Run: ``python -m dlrover_tpu.brain.service --port 8600 --data_dir /var/brain``
 """
@@ -117,9 +125,36 @@ class BrainStore:
         if due:
             self.compact(kind)
 
-    def load(self, kind: str) -> List[Dict]:
+    def load(self, kind: str, job_name: Optional[str] = None) -> List[Dict]:
         with self._lock:
-            return self._load_unlocked(kind)
+            records = self._load_unlocked(kind)
+        if job_name is None:
+            return records
+        return [r for r in records if r.get("job_name") == job_name]
+
+    def stats(self) -> Dict:
+        return {
+            "backend": "jsonl",
+            "dir": self._dir,
+            "records": {
+                kind: len(self.load(kind))
+                for kind in ("runtime", "completion")
+            },
+            "max_records": self._max_records,
+            "max_age_s": self._max_age_s,
+        }
+
+    def job_names(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for kind in ("runtime", "completion"):
+            for r in self.load(kind):
+                name = r.get("job_name")
+                if name:
+                    counts[name] = counts.get(name, 0) + 1
+        return counts
+
+    def close(self):
+        pass
 
     def _load_unlocked(self, kind: str) -> List[Dict]:
         records = []
@@ -140,11 +175,130 @@ class BrainStore:
         return records
 
 
+class SqliteBrainStore:
+    """Persistent-DB datastore (reference go/brain rides MySQL; sqlite
+    is the stdlib equivalent for this scale): same interface as the
+    JSONL store, but filtering happens in SQL over an indexed table and
+    retention is a DELETE, not a file rewrite. Select with
+    ``--store sqlite``."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        max_records: int = 10_000,
+        max_age_s: float = 30 * 24 * 3600.0,
+        compact_every: int = 500,
+    ):
+        import sqlite3
+
+        os.makedirs(data_dir, exist_ok=True)
+        self._dir = data_dir
+        self._path = os.path.join(data_dir, "brain.sqlite")
+        self._max_records = max_records
+        self._max_age_s = max_age_s
+        self._compact_every = max(compact_every, 1)
+        self._appends = 0
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(self._path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS metrics ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " kind TEXT NOT NULL, job_name TEXT, ts REAL NOT NULL,"
+            " record TEXT NOT NULL)"
+        )
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS idx_metrics "
+            "ON metrics (kind, job_name, ts)"
+        )
+        self._db.commit()
+        self.compact()
+
+    def append(self, kind: str, record: Dict):
+        record = dict(record)
+        record["ts"] = time.time()
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO metrics (kind, job_name, ts, record) "
+                "VALUES (?, ?, ?, ?)",
+                (
+                    kind,
+                    record.get("job_name"),
+                    record["ts"],
+                    json.dumps(record),
+                ),
+            )
+            self._db.commit()
+            self._appends += 1
+            due = self._appends % self._compact_every == 0
+        if due:
+            self.compact()
+
+    def load(self, kind: str, job_name: Optional[str] = None) -> List[Dict]:
+        q = "SELECT record FROM metrics WHERE kind = ?"
+        args: list = [kind]
+        if job_name is not None:
+            q += " AND job_name = ?"
+            args.append(job_name)
+        q += " ORDER BY ts, id"  # id tiebreak: same-tick appends
+        with self._lock:
+            rows = self._db.execute(q, args).fetchall()
+        out = []
+        for (blob,) in rows:
+            try:
+                record = json.loads(blob)
+            except ValueError:
+                continue
+            if isinstance(record, dict):
+                out.append(record)
+        return out
+
+    def compact(self, kind: Optional[str] = None):
+        with self._lock:
+            cutoff = time.time() - self._max_age_s
+            self._db.execute("DELETE FROM metrics WHERE ts < ?", (cutoff,))
+            if self._max_records > 0:
+                for k in ("runtime", "completion"):
+                    self._db.execute(
+                        "DELETE FROM metrics WHERE kind = ? AND id NOT IN"
+                        " (SELECT id FROM metrics WHERE kind = ?"
+                        "  ORDER BY ts DESC, id DESC LIMIT ?)",
+                        (k, k, self._max_records),
+                    )
+            self._db.commit()
+
+    def stats(self) -> Dict:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT kind, COUNT(*) FROM metrics GROUP BY kind"
+            ).fetchall()
+        return {
+            "backend": "sqlite",
+            "path": self._path,
+            "records": {k: n for k, n in rows},
+            "max_records": self._max_records,
+            "max_age_s": self._max_age_s,
+        }
+
+    def job_names(self) -> Dict[str, int]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT job_name, COUNT(*) FROM metrics "
+                "WHERE job_name IS NOT NULL AND job_name != '' "
+                "GROUP BY job_name"
+            ).fetchall()
+        return {k: n for k, n in rows}
+
+    def close(self):
+        with self._lock:
+            self._db.close()
+
+
+STORES = {"jsonl": BrainStore, "sqlite": SqliteBrainStore}
+
+
 def _job_samples(store: BrainStore, job_name: str):
     samples = []
-    for s in store.load("runtime"):
-        if s.get("job_name") != job_name:
-            continue
+    for s in store.load("runtime", job_name=job_name):
         try:
             speed = float(s.get("speed", 0))
             count = int(s.get("worker_count", 0))
@@ -233,25 +387,9 @@ def create_optimizer(name: str, store: BrainStore):
     """Resolve an optimizer: a registry name or an external
     ``pkg.module:factory`` dotted path (the plugin contract — factory
     takes the store, returns an object with ``optimize(job_name)``)."""
-    if name in OPTIMIZERS:
-        return OPTIMIZERS[name](store)
-    if ":" in name:
-        import importlib
+    from dlrover_tpu.brain.evaluators import load_plugin
 
-        module, attr = name.split(":", 1)
-        try:
-            factory = getattr(importlib.import_module(module), attr)
-        except (ImportError, AttributeError, ValueError) as e:
-            raise ValueError(
-                f"optimizer plugin {name!r} failed to load ({e}); "
-                f"expected pkg.module:factory, or a registry name from "
-                f"{sorted(OPTIMIZERS)}"
-            ) from e
-        return factory(store)
-    raise ValueError(
-        f"unknown optimizer {name!r}; registry: {sorted(OPTIMIZERS)} "
-        f"or a pkg.module:factory path"
-    )
+    return load_plugin(name, OPTIMIZERS, store, "optimizer")
 
 
 class BrainService:
@@ -262,11 +400,32 @@ class BrainService:
         optimizer: str = "speedup",
         max_records: int = 10_000,
         max_age_s: float = 30 * 24 * 3600.0,
+        store: str = "jsonl",
+        evaluators: Optional[List[str]] = None,
     ):
-        self.store = BrainStore(
+        from dlrover_tpu.brain.evaluators import (
+            EVALUATORS,
+            OptimizeProcessor,
+            create_evaluator,
+        )
+
+        if store not in STORES:
+            raise ValueError(
+                f"unknown store {store!r}; options: {sorted(STORES)}"
+            )
+        self.store = STORES[store](
             data_dir, max_records=max_records, max_age_s=max_age_s
         )
         self.optimizer = create_optimizer(optimizer, self.store)
+        names = (
+            evaluators if evaluators is not None
+            else sorted(EVALUATORS)
+        )
+        self.processor = OptimizeProcessor(
+            self.optimizer,
+            [create_evaluator(n, self.store) for n in names],
+            store=self.store,
+        )
         self._server = ThreadingHTTPServer(
             ("0.0.0.0", port), self._make_handler()
         )
@@ -303,10 +462,30 @@ class BrainService:
                     service.store.append(kind, body.get("record", {}))
                     self._json(200, {"ok": True})
                 elif self.path == "/optimize":
-                    plan = service.optimizer.optimize(
-                        body.get("job_name", "")
+                    # Full processor response: the optimizer's plan
+                    # plus every evaluator's assessment ("plan" key
+                    # unchanged for existing clients).
+                    self._json(
+                        200,
+                        service.processor.process(
+                            body.get("job_name", "")
+                        ),
                     )
-                    self._json(200, {"plan": plan})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_GET(self):
+                # Admin surface (reference brain admin service).
+                if self.path == "/admin/jobs":
+                    self._json(200, {"jobs": service.store.job_names()})
+                elif self.path == "/admin/store":
+                    self._json(200, service.store.stats())
+                elif self.path == "/admin/evaluators":
+                    self._json(200, {
+                        "optimizer": type(service.optimizer).__name__,
+                        "evaluators":
+                            service.processor.evaluator_names,
+                    })
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -322,7 +501,10 @@ class BrainService:
     def stop(self):
         if self._thread is not None:
             self._server.shutdown()
+        # ThreadingHTTPServer's default block_on_close joins in-flight
+        # handler threads here — only then is the store safe to close.
         self._server.server_close()
+        self.store.close()
 
 
 def main(argv=None) -> int:
@@ -337,7 +519,17 @@ def main(argv=None) -> int:
     parser.add_argument("--max_records", type=int, default=10_000)
     parser.add_argument(
         "--max_age_days", type=float, default=30.0,
-        help="retention window for the JSONL store",
+        help="retention window for the store",
+    )
+    parser.add_argument(
+        "--store", type=str, default="jsonl",
+        choices=sorted(STORES),
+        help="datastore backend (sqlite = the reference's persistent DB)",
+    )
+    parser.add_argument(
+        "--evaluators", type=str, default=None,
+        help="comma-separated evaluator names or pkg.module:factory "
+        'paths; omit for all built-ins, pass "" to disable evaluators',
     )
     args = parser.parse_args(argv)
     service = BrainService(
@@ -346,6 +538,14 @@ def main(argv=None) -> int:
         optimizer=args.optimizer,
         max_records=args.max_records,
         max_age_s=args.max_age_days * 24 * 3600.0,
+        store=args.store,
+        evaluators=(
+            None if args.evaluators is None
+            else [
+                e.strip() for e in args.evaluators.split(",")
+                if e.strip()
+            ]
+        ),
     )
     service.start()
     try:

@@ -196,3 +196,128 @@ def test_brain_store_retention(tmp_path):
         str(tmp_path), max_records=5, max_age_s=24 * 3600.0
     )
     assert store2.load("runtime") == []
+
+
+# ---- evaluator/processor architecture + sqlite store ------------------------
+
+
+def _post_raw(port, path, payload):
+    import http.client
+    import json as json_mod
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    body = json_mod.dumps(payload)
+    conn.request("POST", path, body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json_mod.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def _get_raw(port, path):
+    import http.client
+    import json as json_mod
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = json_mod.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+@pytest.fixture(params=["jsonl", "sqlite"])
+def brain_backend(tmp_path, request):
+    service = BrainService(
+        port=0, data_dir=str(tmp_path / f"brain-{request.param}"),
+        store=request.param,
+    )
+    service.start()
+    yield service
+    service.stop()
+
+
+def test_optimize_returns_plan_and_assessments(brain_backend):
+    port = brain_backend.port
+    # Degrading throughput at a fixed worker count, plus an OOM death.
+    for i in range(10):
+        _post_raw(port, "/persist_metrics", {
+            "kind": "runtime",
+            "record": {"job_name": "ev", "worker_count": 4,
+                       "speed": 1000.0 - 40 * i},
+        })
+    _post_raw(port, "/persist_metrics", {
+        "kind": "completion",
+        "record": {"job_name": "ev", "worker_count": 4,
+                   "success": False, "exit_reason": "oom"},
+    })
+    status, body = _post_raw(port, "/optimize", {"job_name": "ev"})
+    assert status == 200
+    assert body["plan"]["worker_count"] == 4
+    by_name = {a["evaluator"]: a for a in body["assessments"]}
+    assert by_name["throughput_trend"]["degrading"] is True
+    assert by_name["oom_risk"]["at_risk"] is True
+    assert "suggestion" in by_name["oom_risk"]
+    assert by_name["straggler"]["speed_cv"] > 0
+
+
+def test_admin_endpoints(brain_backend):
+    port = brain_backend.port
+    _post_raw(port, "/persist_metrics", {
+        "kind": "runtime",
+        "record": {"job_name": "adm", "worker_count": 2, "speed": 10.0},
+    })
+    status, jobs = _get_raw(port, "/admin/jobs")
+    assert status == 200 and jobs["jobs"].get("adm") == 1
+    status, store = _get_raw(port, "/admin/store")
+    assert status == 200
+    assert store["backend"] in ("jsonl", "sqlite")
+    assert store["records"].get("runtime", 0) >= 1
+    status, evs = _get_raw(port, "/admin/evaluators")
+    assert status == 200
+    assert set(evs["evaluators"]) == {
+        "oom_risk", "straggler", "throughput_trend"
+    }
+
+
+def test_sqlite_store_persists_and_compacts(tmp_path):
+    from dlrover_tpu.brain.service import SqliteBrainStore
+
+    d = str(tmp_path / "sq")
+    store = SqliteBrainStore(d, max_records=5)
+    for i in range(12):
+        store.append("runtime", {"job_name": "p", "speed": float(i)})
+    assert len(store.load("runtime")) == 12  # compaction not due yet
+    store.compact()
+    kept = store.load("runtime", job_name="p")
+    assert len(kept) == 5
+    assert [r["speed"] for r in kept] == [7.0, 8.0, 9.0, 10.0, 11.0]
+    store.close()
+    # Persistent: a new instance sees the same records.
+    store2 = SqliteBrainStore(d, max_records=5)
+    assert len(store2.load("runtime")) == 5
+    assert store2.job_names() == {"p": 5}
+    store2.close()
+
+
+def test_evaluator_plugin_path(tmp_path):
+    from dlrover_tpu.brain.evaluators import create_evaluator
+
+    ev = create_evaluator(
+        "tests.test_brain_and_topology:_make_stub_evaluator",
+        store=None,
+    )
+    assert ev.evaluate("x") == {"evaluator": "stub"}
+    with pytest.raises(ValueError, match="unknown evaluator"):
+        create_evaluator("nope", store=None)
+
+
+def _make_stub_evaluator(store):
+    class _Stub:
+        name = "stub"
+
+        def evaluate(self, job_name):
+            return {"evaluator": "stub"}
+
+    return _Stub()
