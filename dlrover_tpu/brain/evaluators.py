@@ -185,13 +185,28 @@ class OptimizeProcessor:
     def evaluator_names(self) -> List[str]:
         return [
             getattr(e, "name", type(e).__name__)
-            for e in self._evaluators
+            for e, _ in self._evaluators
         ]
 
     def __init__(self, optimizer, evaluators, store=None):
+        import inspect
+
         self._optimizer = optimizer
-        self._evaluators = list(evaluators)
         self._store = store
+        # Detect each evaluator's signature ONCE: a per-call
+        # `except TypeError` would misread a genuine TypeError inside
+        # an evaluator as a signature mismatch and run it twice.
+        self._evaluators = []
+        for ev in evaluators:
+            try:
+                params = inspect.signature(ev.evaluate).parameters
+                wants_data = "runtime" in params or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()
+                )
+            except (TypeError, ValueError):
+                wants_data = False
+            self._evaluators.append((ev, wants_data))
 
     def process(self, job_name: str) -> Dict:
         plan = None
@@ -209,15 +224,14 @@ class OptimizeProcessor:
                 "completion", job_name=job_name
             )
         assessments = []
-        for ev in self._evaluators:
+        for ev, wants_data in self._evaluators:
             try:
-                try:
+                if wants_data:
                     a = ev.evaluate(
                         job_name, runtime=runtime,
                         completions=completions,
                     )
-                except TypeError:
-                    # External plugins may keep the simple signature.
+                else:  # external plugins may keep the simple signature
                     a = ev.evaluate(job_name)
             except Exception:  # noqa: BLE001
                 logger.exception(

@@ -426,6 +426,8 @@ class BrainService:
             [create_evaluator(n, self.store) for n in names],
             store=self.store,
         )
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         self._server = ThreadingHTTPServer(
             ("0.0.0.0", port), self._make_handler()
         )
@@ -438,6 +440,20 @@ class BrainService:
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
+
+            def handle(self):
+                # In-flight accounting: ThreadingHTTPServer's handler
+                # threads are DAEMON threads (server_close joins
+                # nothing), so stop() must wait for this count to drain
+                # before closing the store under a live handler.
+                with service._inflight_cv:
+                    service._inflight += 1
+                try:
+                    super().handle()
+                finally:
+                    with service._inflight_cv:
+                        service._inflight -= 1
+                        service._inflight_cv.notify_all()
 
             def _json(self, code: int, payload):
                 data = json.dumps(payload).encode()
@@ -501,9 +517,14 @@ class BrainService:
     def stop(self):
         if self._thread is not None:
             self._server.shutdown()
-        # ThreadingHTTPServer's default block_on_close joins in-flight
-        # handler threads here — only then is the store safe to close.
         self._server.server_close()
+        # Handler threads are daemons (server_close joins nothing);
+        # wait for in-flight requests to drain before closing the store
+        # under them.
+        with self._inflight_cv:
+            self._inflight_cv.wait_for(
+                lambda: self._inflight == 0, timeout=10.0
+            )
         self.store.close()
 
 

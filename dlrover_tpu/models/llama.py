@@ -59,6 +59,11 @@ class TpuLMConfig:
     #   (its Pallas flash kernel would otherwise re-run in the backward —
     #   a measured ~1ms/layer/step on v5e) while the MLP half keeps the
     #   "dots" policy. Costs ~+130MB/layer of saved attention residuals.
+    # "attn_save": the long-context middle ground — the attention call
+    #   still escapes remat (at 32k tokens re-running flash attention is
+    #   the dominant remat cost) but BOTH flanks recompute fully, so the
+    #   saved state stays O(s*d)/layer where "mlp_only"'s dots flanks
+    #   would pin the [s, mlp_dim] hiddens (the 32k OOM).
     # "dots": selective rematerialization — matmul outputs are saved,
     #   only elementwise work recomputes in the backward (measured +2 MFU
     #   points over full remat on v5e at the bench config).
@@ -67,10 +72,13 @@ class TpuLMConfig:
     remat_policy: str = "mlp_only"
 
     def __post_init__(self):
-        if self.remat_policy not in ("mlp_only", "dots", "full"):
+        if self.remat_policy not in (
+            "mlp_only", "attn_save", "dots", "full"
+        ):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r} not in ('mlp_only', "
-                f"'dots', 'full') — a typo here silently costs MFU"
+                f"'attn_save', 'dots', 'full') — a typo here silently "
+                f"costs MFU"
             )
         if self.moe_impl not in ("auto", "gshard", "dropless"):
             raise ValueError(
@@ -470,28 +478,34 @@ def run_layer_stack(
     # intermediates per layer. Impls that keep O(s*d) residuals declare
     # it via a ``saveable_residuals`` attribute; everything else demotes
     # to the "dots" policy.
-    mlp_only = (
+    attn_escapes = (
         config.remat
-        and config.remat_policy == "mlp_only"
+        and config.remat_policy in ("mlp_only", "attn_save")
         and getattr(attention_fn, "saveable_residuals", False)
     )
-    if mlp_only:
+    if attn_escapes:
         # Only the flash-attention call itself escapes rematerialization
         # (re-running its Pallas forward in the backward costs a measured
-        # ~1ms/layer/step on v5e). Both flanks keep the dots policy, so
-        # the extra saved state is just (q_roped, k_roped, v, attn_out)
-        # plus the compact lse — the pre-rope projections DCE away
-        # because rope's backward only needs the (recomputed) sin/cos.
+        # ~1ms/layer/step at 2k and dominates the remat bill at 32k).
+        # "mlp_only": flanks keep the dots policy — the extra saved
+        # state is just (q_roped, k_roped, v, attn_out) plus the compact
+        # lse; the pre-rope projections DCE away because rope's backward
+        # only needs the (recomputed) sin/cos. "attn_save": flanks
+        # recompute fully — the long-context memory budget.
+        flank_policy = (
+            dots_policy if config.remat_policy == "mlp_only" else None
+        )
         attn_fn = attention_fn or dot_product_attention
         ckpt_qkv = jax.checkpoint(
-            functools.partial(attention_qkv, config), policy=dots_policy
+            functools.partial(attention_qkv, config),
+            policy=flank_policy,
         )
 
         def out_mlp(p, attn, residual):
             y = attention_out(config, p, attn, residual)
             return mlp_block(config, p, y)
 
-        ckpt_out_mlp = jax.checkpoint(out_mlp, policy=dots_policy)
+        ckpt_out_mlp = jax.checkpoint(out_mlp, policy=flank_policy)
 
         def body(carry, pl):
             q, k, v = ckpt_qkv(pl, carry, positions)
@@ -638,16 +652,17 @@ def loss_fn(config, params, batch, attention_fn=None):
     """
     tokens = batch["tokens"][:, :-1]
     targets = batch["tokens"][:, 1:]
-    # The chunked fused CE runs at ~1.01-1.07x dense on v5e (same three
+    # The chunked fused CE runs at ~0.99-1.07x dense on v5e (same three
     # matmuls; gradients computed in the forward, see ops/fused_ce.py)
-    # while never materializing the [N, V] logits. "auto" engages it when
-    # the f32 logits would be prohibitive (> ~4GB, e.g. long-context SFT
-    # where dense simply OOMs); below that, dense keeps its measured edge
-    # on the flagship MFU path.
+    # while never materializing the [N, V] logits. "auto" engages it
+    # once the f32 logits pass 2 GiB — at that scale the memory freed
+    # matters (it is what lets the attn_save remat policy fit at 32k
+    # tokens) and the time cost is a wash; below it, dense keeps its
+    # measured edge on the flagship MFU path.
     mode = _fused_ce_mode()
     logits_bytes = tokens.size * config.vocab_size * 4
     use_fused = mode == "on" or (
-        mode == "auto" and logits_bytes > 4 * 1024**3
+        mode == "auto" and logits_bytes > 2 * 1024**3
     )
     if use_fused and _fused_ce_applicable(config):
         from dlrover_tpu.ops.fused_ce import fused_cross_entropy
@@ -656,11 +671,19 @@ def loss_fn(config, params, batch, attention_fn=None):
             config, params, tokens, attention_fn=attention_fn
         )
         h = final_hidden(config, params, x)
+        # Long sequences cap the CE row chunk at 4096: the 8192-row
+        # tile pushed the whole-program TPU compile over the edge when
+        # combined with the attn_save remat policy (measured v5e:
+        # compile-helper failure at 32k tokens; 4096 compiles and times
+        # identically there, and at long context the CE is ~2% of the
+        # step). Short-sequence large-batch runs keep the measured-
+        # fastest auto chunk.
         ce = fused_cross_entropy(
             h,
             params["lm_head"].astype(config.compute_dtype),
             targets,
             batch.get("mask"),
+            block_rows=4096 if tokens.shape[1] >= 32768 else None,
         )
     else:
         logits, aux = forward(
