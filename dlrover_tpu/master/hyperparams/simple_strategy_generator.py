@@ -43,6 +43,8 @@ class SimpleStrategyGenerator:
         self._devices_per_node = devices_per_node
         self._version = 0
         self._last: Optional[comm.ParallelConfig] = None
+        self._remat_stage = 0  # 0: none, 1: attn_save, 2: full
+        self._ooms_seen = 0
 
     def generate(self) -> Optional[comm.ParallelConfig]:
         """Suggest knobs for the current world; None if undecidable."""
@@ -114,13 +116,27 @@ class SimpleStrategyGenerator:
         return micro
 
     def _suggest_remat(self) -> str:
-        """Turn on activation rematerialization after OOM evidence."""
-        ooms = [
-            n
+        """Escalate activation rematerialization on OOM evidence: the
+        first OOM EPISODE suggests "attn_save" (attention stays
+        un-rematted — its re-run dominates the remat bill, see
+        models/llama.py remat policies); OOM evidence arriving AFTER
+        that suggestion escalates to "full". Staged on episodes, not
+        record counts: SPMD memory use is symmetric, so one episode in
+        a multi-worker job marks several node records OOM at once."""
+        ooms = sum(
+            1
             for n in self._job_manager.worker_manager.nodes.values()
             if n.exit_reason == NodeExitReason.OOM
-        ]
-        return "full" if ooms else ""
+        )
+        if ooms == 0:
+            return ""
+        if self._remat_stage == 0:
+            self._remat_stage = 1
+        elif self._remat_stage == 1 and ooms > self._ooms_seen:
+            # attn_save was already suggested and workers OOMed again.
+            self._remat_stage = 2
+        self._ooms_seen = max(self._ooms_seen, ooms)
+        return "attn_save" if self._remat_stage == 1 else "full"
 
     def _changed(self, config: comm.ParallelConfig) -> bool:
         last = self._last

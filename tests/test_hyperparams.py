@@ -97,8 +97,22 @@ def test_generator_remat_after_oom():
         node = list(mgr.worker_manager.nodes.values())[0]
         node.exit_reason = NodeExitReason.OOM
         config = gen.generate()
-        assert config.remat_policy == "full"
+        # first OOM episode: the cheap escalation (attention stays
+        # un-rematted); stable across polls with no new evidence
+        assert config.remat_policy == "attn_save"
         assert config.version == 2
+        assert gen.generate().remat_policy == "attn_save"
+        # MORE OOM evidence after the suggestion -> full remat. A new
+        # record simulates the relaunched incarnation dying again.
+        import copy
+
+        relaunched = copy.copy(node)
+        relaunched.id = node.id + 1000
+        # .nodes returns a copy; insert through the backing dict
+        mgr.worker_manager._nodes[relaunched.id] = relaunched
+        config = gen.generate()
+        assert config.remat_policy == "full"
+        assert config.version == 3
     finally:
         mgr.stop()
 

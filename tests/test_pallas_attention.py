@@ -98,13 +98,17 @@ def test_mlp_only_remat_matches_dots():
 
     g_dots = grads("dots", flash)
     g_mlp = grads("mlp_only", flash)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
-        ),
-        g_dots,
-        g_mlp,
-    )
+    # attn_save (long-context policy: attention escapes, flanks fully
+    # recompute) must produce identical gradients too.
+    g_attn_save = grads("attn_save", flash)
+    for other in (g_mlp, g_attn_save):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            g_dots,
+            other,
+        )
     # XLA attention has no saveable_residuals attr -> mlp_only demotes to
     # dots rather than pinning O(s^2) residuals.
     g_xla = grads("mlp_only", dot_product_attention)
