@@ -9,6 +9,8 @@ world, and the remat (activation checkpointing) policy when host OOMs
 are observed.
 """
 
+import threading
+import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common import comm
@@ -44,12 +46,22 @@ class SimpleStrategyGenerator:
         self._version = 0
         self._last: Optional[comm.ParallelConfig] = None
         self._remat_stage = 0  # 0: none, 1: attn_save, 2: full
-        self._ooms_seen = 0
+        self._stage1_ts = 0.0
+        # generate() mutates suggestion state and is called from every
+        # agent tuner's poll through the master's threaded RPC pool —
+        # unserialized, two concurrent polls could version-bump twice
+        # for identical configs (each bump makes workers rebuild their
+        # jitted step: a full XLA recompile).
+        self._gen_lock = threading.Lock()
 
     def generate(self) -> Optional[comm.ParallelConfig]:
         """Suggest knobs for the current world; None if undecidable."""
         if self._job_manager is None:
             return None
+        with self._gen_lock:
+            return self._generate_locked()
+
+    def _generate_locked(self) -> Optional[comm.ParallelConfig]:
         workers = self._job_manager.worker_manager.running_nodes()
         if not workers:
             return self._last
@@ -119,23 +131,28 @@ class SimpleStrategyGenerator:
         """Escalate activation rematerialization on OOM evidence: the
         first OOM EPISODE suggests "attn_save" (attention stays
         un-rematted — its re-run dominates the remat bill, see
-        models/llama.py remat policies); OOM evidence arriving AFTER
-        that suggestion escalates to "full". Staged on episodes, not
-        record counts: SPMD memory use is symmetric, so one episode in
-        a multi-worker job marks several node records OOM at once."""
-        ooms = sum(
-            1
+        models/llama.py remat policies); a LATER episode escalates to
+        "full". Episode attribution uses record creation time: a
+        relaunched worker that OOMs again gets a NEW node record
+        (created after the attn_save suggestion), while stragglers of
+        the original episode — e.g. a silent death only marked OOM by
+        the heartbeat timeout minutes later — are OLD records marked
+        late, and must not escalate past a policy no worker has run
+        with yet."""
+        ooms = [
+            n
             for n in self._job_manager.worker_manager.nodes.values()
             if n.exit_reason == NodeExitReason.OOM
-        )
-        if ooms == 0:
+        ]
+        if not ooms:
             return ""
         if self._remat_stage == 0:
             self._remat_stage = 1
-        elif self._remat_stage == 1 and ooms > self._ooms_seen:
-            # attn_save was already suggested and workers OOMed again.
+            self._stage1_ts = time.time()
+        elif self._remat_stage == 1 and any(
+            (n.create_time or 0.0) > self._stage1_ts for n in ooms
+        ):
             self._remat_stage = 2
-        self._ooms_seen = max(self._ooms_seen, ooms)
         return "attn_save" if self._remat_stage == 1 else "full"
 
     def _changed(self, config: comm.ParallelConfig) -> bool:

@@ -51,6 +51,12 @@ def parse_args():
     p.add_argument("--seq", type=int, default=256)
     p.add_argument("--dataset-size", type=int, default=1_000_000)
     p.add_argument("--persist-every", type=int, default=20)
+    p.add_argument(
+        "--remat-policy", type=str, default="mlp_only",
+        choices=["mlp_only", "attn_save", "dots", "full"],
+        help="activation remat dial: mlp_only for short sequences, "
+        "attn_save for long-context (see docs/DESIGN.md #17)",
+    )
     return p.parse_args()
 
 
@@ -67,7 +73,7 @@ def main():
     # (swap in tp/pp/sp axes via MeshConfig for bigger models).
     n_devices = jax.device_count()
     mesh = build_mesh(MeshConfig(dp=n_devices), jax.devices())
-    cfg = llama.tiny_config(n_layers=4)
+    cfg = llama.tiny_config(n_layers=4, remat_policy=args.remat_policy)
     tc = ts.TrainConfig(warmup_steps=20)
     opt = ts.make_optimizer(tc)
 

@@ -196,3 +196,36 @@ def test_warm_standby_adopted_on_restart(master, saver_client, tmp_path):
     adopted = [w for w in agent._workers if w.process.pid == standby_pid]
     assert adopted, "restart did not adopt the warm standby"
     assert agent._standby is None, "standby not closed after run()"
+
+
+def test_dead_standby_falls_back_to_cold_spawn(
+    master, saver_client, tmp_path
+):
+    """A standby that died before adoption must not break restarts —
+    the agent falls back to a cold spawn and respawns a standby."""
+    client, saver = saver_client
+    spec, out = _spec(tmp_path, total=12)
+    spec.warm_standby = True
+    agent = ElasticAgent(spec, client, ckpt_saver=saver)
+    result_box = {}
+
+    def run():
+        result_box["result"] = agent.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if len(_read_progress(out)) >= 3 and agent._standby is not None:
+            break
+        time.sleep(0.1)
+    assert agent._standby is not None
+    # Kill the STANDBY first, then the worker: adoption must detect the
+    # dead standby and cold-spawn.
+    agent._standby.kill()
+    agent._standby.wait(timeout=10)
+    os.kill(agent._workers[0].process.pid, signal.SIGKILL)
+    t.join(timeout=60)
+    assert result_box.get("result") == RunResult.SUCCEEDED
+    steps = [p[1] for p in _read_progress(out)]
+    assert steps[-1] == 12

@@ -382,3 +382,25 @@ def test_async_writer_does_not_pollute_block_cost(tmp_path):
         assert block < 0.05, f"async launch recorded as {block}s"
     finally:
         engine.close()
+
+
+def test_fetch_barrier_touches_every_leaf():
+    """The restore-timing barrier must fetch through every leaf (it is
+    the honest replacement for block_until_ready, which can return
+    early on async-dispatch backends) and tolerate mixed dtypes."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.flash_ckpt.engine import fetch_barrier
+
+    tree = {
+        "params": {"w": jnp.ones((4, 4)), "b": jnp.arange(3)},
+        "step": jnp.asarray(7, jnp.int32),
+        "flag": jnp.asarray(True),
+        "meta": "not-an-array",  # non-array leaves are skipped
+    }
+    total = fetch_barrier(tree)
+    # 1.0 (w[0,0]) + 0 (b[0]) + 7 (step) + 1 (flag)
+    assert total == 9.0
+    # Second call reuses the cached jitted probe (same avals).
+    assert fetch_barrier(tree) == 9.0

@@ -106,8 +106,14 @@ def test_generator_remat_after_oom():
         # record simulates the relaunched incarnation dying again.
         import copy
 
+        import time as time_mod
+
         relaunched = copy.copy(node)
         relaunched.id = node.id + 1000
+        # A record CREATED after the attn_save suggestion = the
+        # relaunched incarnation OOMing again (old records marked late
+        # must NOT escalate — covered by the stability assert above).
+        relaunched.create_time = time_mod.time() + 1.0
         # .nodes returns a copy; insert through the backing dict
         mgr.worker_manager._nodes[relaunched.id] = relaunched
         config = gen.generate()
