@@ -53,17 +53,11 @@ def device_peak_flops() -> float:
 def probe_d2h_bandwidth_mbs() -> float:
     """Measured device->host MB/s: flash-ckpt save cost is dominated by
     this, and it varies ~1000x between a local PCIe TPU and a tunneled
-    dev chip. The bench sizes the goodput model so one state transfer
-    stays bounded regardless."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    dev chip. Shared with the e2e worker (bench_e2e.probe_d2h_mbs) so
+    both benches size their models from the same measurement."""
+    from bench_e2e import probe_d2h_mbs
 
-    x = jnp.ones((2 * 1024 * 1024,), jnp.float32)  # 8 MB
-    jax.block_until_ready(x)
-    t0 = time.time()
-    np.asarray(x)
-    return 8.0 / max(time.time() - t0, 1e-6)
+    return probe_d2h_mbs()
 
 
 # ---------------------------------------------------------------------------
@@ -824,12 +818,18 @@ def build_goodput_model(platform: str):
     else:
         bw = probe_d2h_bandwidth_mbs()
         if bw < 100.0:
-            # Tunneled/remote chip: keep the train state small enough
-            # that a full shm save stays ~10s at the measured bandwidth.
+            # Tunneled/remote chip: tier the train state by the
+            # MEASURED bandwidth so the wire-bound restore/drain stays
+            # bounded even on bad tunnel days (the restore seconds are
+            # state bytes over whatever the wire gives — reported via
+            # ckpt_restore_load_s/h2d_s).
+            from bench_e2e import tier_layers
+
+            layers = tier_layers(bw)
             cfg = llama.TpuLMConfig(
                 vocab_size=4096,
                 embed_dim=256,
-                n_layers=4,
+                n_layers=layers,
                 n_heads=8,
                 n_kv_heads=4,
                 head_dim=32,
@@ -1047,6 +1047,7 @@ def e2e_phase():
     d = json.loads(lines[-1])
     out = {"measured_recovery_s": d.get("value")}
     for key in (
+        "machinery_recovery_s",
         "detect_restart_s",
         "runtime_init_s",
         "restore_s",

@@ -10,7 +10,6 @@ are observed.
 """
 
 import threading
-import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common import comm
@@ -45,8 +44,6 @@ class SimpleStrategyGenerator:
         self._devices_per_node = devices_per_node
         self._version = 0
         self._last: Optional[comm.ParallelConfig] = None
-        self._remat_stage = 0  # 0: none, 1: attn_save, 2: full
-        self._stage1_ts = 0.0
         # generate() mutates suggestion state and is called from every
         # agent tuner's poll through the master's threaded RPC pool —
         # unserialized, two concurrent polls could version-bump twice
@@ -131,29 +128,27 @@ class SimpleStrategyGenerator:
         """Escalate activation rematerialization on OOM evidence: the
         first OOM EPISODE suggests "attn_save" (attention stays
         un-rematted — its re-run dominates the remat bill, see
-        models/llama.py remat policies); a LATER episode escalates to
-        "full". Episode attribution uses record creation time: a
-        relaunched worker that OOMs again gets a NEW node record
-        (created after the attn_save suggestion), while stragglers of
-        the original episode — e.g. a silent death only marked OOM by
-        the heartbeat timeout minutes later — are OLD records marked
-        late, and must not escalate past a policy no worker has run
-        with yet."""
-        ooms = [
-            n
-            for n in self._job_manager.worker_manager.nodes.values()
+        models/llama.py remat policies); a REPEATED episode escalates
+        to "full". Episode attribution rides the lineage exit history
+        (get_relaunch_node shares it across relaunches): one symmetric
+        SPMD episode stamps each lineage ONCE no matter how many
+        records it marks or how late (heartbeat-timeout) the marks
+        land, while a lineage with two OOM exits has provably OOMed
+        again after a relaunch — timing-free, so it cannot be confused
+        by when records were created or polled."""
+        nodes = self._job_manager.worker_manager.nodes.values()
+        evidence = [
+            n for n in nodes
             if n.exit_reason == NodeExitReason.OOM
+            or n.exit_count(NodeExitReason.OOM) > 0
         ]
-        if not ooms:
+        if not evidence:
             return ""
-        if self._remat_stage == 0:
-            self._remat_stage = 1
-            self._stage1_ts = time.time()
-        elif self._remat_stage == 1 and any(
-            (n.create_time or 0.0) > self._stage1_ts for n in ooms
+        if any(
+            n.exit_count(NodeExitReason.OOM) >= 2 for n in evidence
         ):
-            self._remat_stage = 2
-        return "attn_save" if self._remat_stage == 1 else "full"
+            return "full"
+        return "attn_save"
 
     def _changed(self, config: comm.ParallelConfig) -> bool:
         last = self._last

@@ -96,24 +96,20 @@ def test_generator_remat_after_oom():
         assert gen.generate().remat_policy == ""
         node = list(mgr.worker_manager.nodes.values())[0]
         node.exit_reason = NodeExitReason.OOM
+        node.record_exit(NodeExitReason.OOM)
         config = gen.generate()
         # first OOM episode: the cheap escalation (attention stays
         # un-rematted); stable across polls with no new evidence
         assert config.remat_policy == "attn_save"
         assert config.version == 2
         assert gen.generate().remat_policy == "attn_save"
-        # MORE OOM evidence after the suggestion -> full remat. A new
-        # record simulates the relaunched incarnation dying again.
-        import copy
-
-        import time as time_mod
-
-        relaunched = copy.copy(node)
-        relaunched.id = node.id + 1000
-        # A record CREATED after the attn_save suggestion = the
-        # relaunched incarnation OOMing again (old records marked late
-        # must NOT escalate — covered by the stability assert above).
-        relaunched.create_time = time_mod.time() + 1.0
+        # The relaunched incarnation OOMs AGAIN: the production path
+        # builds the replacement record via get_relaunch_node (which
+        # SHARES the lineage exit history) and records a second OOM
+        # exit — that lineage signal escalates to full remat.
+        relaunched = node.get_relaunch_node(node.id + 1000)
+        relaunched.exit_reason = NodeExitReason.OOM
+        relaunched.record_exit(NodeExitReason.OOM)
         # .nodes returns a copy; insert through the backing dict
         mgr.worker_manager._nodes[relaunched.id] = relaunched
         config = gen.generate()

@@ -85,6 +85,10 @@ def render_block(path: str) -> str:
         ("Measured SIGKILL recovery (detect+restart+restore+replay)",
          g("measured_recovery_s"),
          f"{fmt(g('measured_recovery_s'))} s"),
+        ("— of which recovery machinery (excl. wire-bound state "
+         "transfer)",
+         g("e2e_machinery_recovery_s"),
+         f"{fmt(g('e2e_machinery_recovery_s'))} s"),
         ("End-to-end goodput @ MTBF 3600s, autotuned cadence",
          g("e2e_goodput_pct"),
          f"{fmt(g('e2e_goodput_pct'))}%"
