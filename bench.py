@@ -822,20 +822,11 @@ def build_goodput_model(platform: str):
             # MEASURED bandwidth so the wire-bound restore/drain stays
             # bounded even on bad tunnel days (the restore seconds are
             # state bytes over whatever the wire gives — reported via
-            # ckpt_restore_load_s/h2d_s).
-            from bench_e2e import tier_layers
+            # ckpt_restore_load_s/h2d_s). Same model as the e2e
+            # harness's worker (bench_e2e.tiered_config).
+            from bench_e2e import tier_layers, tiered_config
 
-            layers = tier_layers(bw)
-            cfg = llama.TpuLMConfig(
-                vocab_size=4096,
-                embed_dim=256,
-                n_layers=layers,
-                n_heads=8,
-                n_kv_heads=4,
-                head_dim=32,
-                mlp_dim=1024,
-                dtype="bfloat16",
-            )
+            cfg = tiered_config(tier_layers(bw))
             batch, seq, steps = 8, 512, 24
         else:
             cfg = llama.TpuLMConfig(

@@ -84,6 +84,24 @@ def tier_layers(bw_mbs: float) -> int:
     return 4 if bw_mbs >= 8.0 else (2 if bw_mbs >= 3.0 else 1)
 
 
+def tiered_config(n_layers: int):
+    """The ONE recovery-bench model, shared by bench.py's goodput
+    phase and this harness's worker so both measure the same workload
+    (only the bandwidth-tiered layer count varies)."""
+    from dlrover_tpu.models import llama
+
+    return llama.TpuLMConfig(
+        vocab_size=4096,
+        embed_dim=256,
+        n_layers=n_layers,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=32,
+        mlp_dim=1024,
+        dtype="bfloat16",
+    )
+
+
 # ---------------------------------------------------------------------------
 # Worker mode
 # ---------------------------------------------------------------------------
@@ -147,16 +165,7 @@ def worker_main(events_path: str, ckpt_dir: str, cache_dir: str):
             emit("sized", layers=layers, d2h_mbs=round(bw_mbs, 1))
             with open(preset_path, "w") as f:
                 json.dump({"n_layers": layers}, f)
-        cfg = llama.TpuLMConfig(
-            vocab_size=4096,
-            embed_dim=256,
-            n_layers=layers,
-            n_heads=8,
-            n_kv_heads=4,
-            head_dim=32,
-            mlp_dim=1024,
-            dtype="bfloat16",
-        )
+        cfg = tiered_config(layers)
         batch, seq = 8, 512
 
     mesh = build_mesh(MeshConfig(dp=len(jax.devices())), jax.devices())

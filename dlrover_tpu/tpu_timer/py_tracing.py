@@ -105,7 +105,32 @@ def install_stack_dump_handler(fileobj=None):
 
 
 def dump_stacks(fileobj=None):
-    """Immediate all-thread dump (in-process watchdogs)."""
-    faulthandler.dump_traceback(
-        file=fileobj or sys.stderr, all_threads=True
-    )
+    """Immediate all-thread dump (in-process watchdogs).
+
+    CPython's faulthandler silently caps the dump at 100 threads — in
+    a process with many daemon threads (servers, agents, pools) the
+    CALLING thread can be among the omitted, which defeats the usual
+    "where am I stuck" question. Emit the current stack explicitly
+    first in faulthandler-compatible format (the stacks analysis tool
+    parses it) whenever the thread count approaches the cap."""
+    f = fileobj or sys.stderr
+    if len(sys._current_frames()) > 90:
+        import threading
+
+        # Header matches the analysis tool's thread regex (hex id
+        # required) so the explicit stack is parsed, not dropped.
+        f.write(
+            f"Current thread 0x{threading.get_ident():x} "
+            "(most recent call first):\n"
+        )
+        frame = sys._getframe(1)
+        while frame is not None:
+            code = frame.f_code
+            f.write(
+                f'  File "{code.co_filename}", line {frame.f_lineno} '
+                f"in {code.co_name}\n"
+            )
+            frame = frame.f_back
+        f.write("\n")
+        f.flush()
+    faulthandler.dump_traceback(file=f, all_threads=True)
