@@ -114,11 +114,15 @@ def dump_stacks(fileobj=None):
     first in faulthandler-compatible format (the stacks analysis tool
     parses it) whenever the thread count approaches the cap."""
     f = fileobj or sys.stderr
-    if len(sys._current_frames()) > 90:
+    if len(sys._current_frames()) > 100:
+        # Only when the cap actually binds: below it faulthandler
+        # includes every thread and an explicit copy would double-count
+        # the caller in the stack histograms. Over the cap, a possible
+        # duplicate beats a possible omission. Header matches the
+        # analysis tool's thread regex (hex id required) so the
+        # explicit stack is parsed, not dropped.
         import threading
 
-        # Header matches the analysis tool's thread regex (hex id
-        # required) so the explicit stack is parsed, not dropped.
         f.write(
             f"Current thread 0x{threading.get_ident():x} "
             "(most recent call first):\n"
