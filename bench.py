@@ -411,6 +411,19 @@ def profiler_overhead_phase():
         except Exception as e:  # noqa: BLE001 - report, don't vanish
             errors.append(f"{type(e).__name__}: {e}"[:200])
 
+    if window_s < default_window_s:
+        # The pair delta is millisecond-scale; extrapolating it by
+        # default/measured window ratio would amplify run-to-run jitter
+        # 5-25x into a fabricated number. Refuse BEFORE paying for the
+        # measurement loop — the run is too short for the default
+        # window.
+        del state
+        return {
+            "profiler_overhead_error": (
+                f"run too short for the default {default_window_s}s "
+                f"window (fit {window_s:.2f}s); raise steps"
+            )
+        }
     # Median of three (clean, captured) pairs: the delta is
     # millisecond-scale and a single pair is at the mercy of tunnel
     # step-time jitter (observed 0.17-0.65% across identical runs).
@@ -430,17 +443,6 @@ def profiler_overhead_phase():
         return {
             "profiler_overhead_error": (
                 errors[0] if errors else "capture produced no events"
-            )
-        }
-    if window_s < default_window_s:
-        # The two-run delta is millisecond-scale; extrapolating it by
-        # default/measured window ratio would amplify run-to-run jitter
-        # 5-25x into a fabricated number. Refuse instead — the run was
-        # too short for the default window.
-        return {
-            "profiler_overhead_error": (
-                f"run too short for the default {default_window_s}s "
-                f"window (fit {window_s:.2f}s); raise steps"
             )
         }
     cost_ms = sorted(deltas)[len(deltas) // 2] * 1e3

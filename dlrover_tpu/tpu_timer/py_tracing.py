@@ -112,7 +112,11 @@ def dump_stacks(fileobj=None):
     CALLING thread can be among the omitted, which defeats the usual
     "where am I stuck" question. Emit the current stack explicitly
     first in faulthandler-compatible format (the stacks analysis tool
-    parses it) whenever the thread count approaches the cap."""
+    parses it) once the count EXCEEDS the cap — below it every thread
+    is included and a copy would double-count the caller in the stack
+    histograms. (Threads spawned between the check and the dump can
+    still race past the cap; the guard trades that sliver for
+    duplicate-free histograms in the common case.)"""
     f = fileobj or sys.stderr
     if len(sys._current_frames()) > 100:
         # Only when the cap actually binds: below it faulthandler

@@ -225,7 +225,7 @@ def test_dead_standby_falls_back_to_cold_spawn(
     agent._standby.kill()
     agent._standby.wait(timeout=10)
     os.kill(agent._workers[0].process.pid, signal.SIGKILL)
-    t.join(timeout=60)
+    t.join(timeout=180)  # generous: full-suite load slows subprocesses
     assert result_box.get("result") == RunResult.SUCCEEDED
     steps = [p[1] for p in _read_progress(out)]
     assert steps[-1] == 12

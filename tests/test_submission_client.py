@@ -8,6 +8,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -104,3 +105,37 @@ def test_submit_from_separate_process(server, tmp_path):
     assert out.returncode == 0, out.stderr[-2000:]
     assert out.stdout.strip().endswith("SUCCEEDED")
     assert (tmp_path / "out.trainer0").exists()
+
+
+def test_stop_running_job(server, tmp_path):
+    """POST /jobs/<name>/stop halts a long-running job; its stage is
+    terminal afterwards and a rerun under the same name is accepted."""
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir(exist_ok=True)
+    (mod_dir / "slowworker.py").write_text(
+        "import time\ntime.sleep(60)\n"
+    )
+    cfg = {
+        "job_name": "stoppable",
+        "roles": [{
+            "name": "w", "entrypoint": "slowworker", "total": 1,
+            "envs": {"PYTHONPATH":
+                     f"{mod_dir}:{os.environ.get('PYTHONPATH', '')}"},
+        }],
+    }
+    sub = JobSubmitter(server.addr, token=server.token)
+    name = sub.submit(cfg)
+    # Duplicate submit while running is refused.
+    with pytest.raises(SubmitError, match="already running"):
+        sub.submit(cfg)
+    rsp = sub.stop(name)
+    assert rsp["job_name"] == name
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if sub.status(name)["stage"] in ("FAILED", "SUCCEEDED"):
+            break
+        time.sleep(0.2)
+    assert sub.status(name)["stage"] in ("FAILED", "SUCCEEDED")
+    # A stopped (terminal) job is re-submittable under the same name.
+    assert sub.submit(cfg) == name
+    sub.stop(name)
