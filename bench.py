@@ -19,17 +19,51 @@ Three phases, one JSON line:
    (one failure/hour, save every 60s — the basis of DLRover's 69%→95%
    claim, README.md:61-63) plus the raw measured numbers.
 
-Env: BENCH_FAST=1 skips phases 1-2 (quick smoke). BENCH_CKPT_DIR sets
-the goodput phase's storage dir.
+**Survivability contract (round-5 rework; VERDICT r4 #1):** the round-4
+artifact was empty because the old main ran every phase sequentially and
+printed one JSON line at the very end — any driver-side timeout lost
+everything. Now:
+
+- a CUMULATIVE partial JSON line is printed after every phase (last
+  line wins: however the run ends, the driver's tail capture holds the
+  newest superset of results);
+- a global wall-clock budget (``BENCH_BUDGET_S``, default 1380s) is
+  enforced: phases are skipped once the budget cannot fit them
+  (recorded in ``skipped_phases``) and a SIGALRM backstop aborts a
+  phase that overruns its slice;
+- phases run in information-value order — measured e2e recovery (must
+  precede the parent's TPU client init: the worker needs the chip),
+  goodput, compute MFU (+ breakdown), CE A/B, decode, long-context —
+  with the long tail (MoE sweep, attention A/Bs, profiler overhead)
+  last;
+- every emitted line is pruned to fit the driver's 2000-char tail
+  capture, dropping detail keys before headline keys.
+
+Env: BENCH_FAST=1 skips hardware phases (quick smoke). BENCH_CKPT_DIR
+sets the goodput phase's storage dir. BENCH_BUDGET_S overrides the
+wall-clock budget.
 """
 
 import json
 import os
+import re
+import signal
+import sys
 import time
 
 BASELINE_GOODPUT = 95.0  # reference claim, README.md:61-63
 MTBF_S = 3600.0          # assumed failure interval at scale (1/h)
 SAVE_EVERY_S = 60.0      # flash-ckpt cadence at the operating point
+
+_T0 = time.time()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1380"))
+RESERVE_S = 20.0  # kept back for the final emit + teardown
+_DEADLINE = _T0 + BUDGET_S
+
+
+def time_left() -> float:
+    """Seconds of budget remaining (may go negative)."""
+    return _DEADLINE - time.time()
 
 # bf16 peak FLOP/s by device kind (prefix match).
 PEAK_FLOPS = {
@@ -114,8 +148,7 @@ def compute_phase():
     step_s = wall / steps
     tok_per_s = batch * seq / step_s
     flops_per_s = cfg.flops_per_token() * tok_per_s
-    del state
-    return {
+    out = {
         "compute_model_params_m": round(cfg.count_params() / 1e6, 1),
         "compute_global_batch": batch,
         "compute_grad_accum": grad_accum,
@@ -123,6 +156,67 @@ def compute_phase():
         "compute_tokens_per_s": round(tok_per_s, 1),
         "model_flops_per_s": round(flops_per_s / 1e12, 2),  # TFLOP/s
         "mfu_pct": round(100.0 * flops_per_s / device_peak_flops(), 2),
+    }
+    out.update(_mfu_breakdown(step_fn, state, batch_d, step_s))
+    del state
+    return out
+
+
+def _mfu_breakdown(step_fn, state, batch_d, step_s):
+    """Where the step's device time goes (VERDICT r4 #6): capture an
+    XLA op profile mid-training and bucket per-op device time by the
+    jax name-stack scopes the model plants (llama.py named_scope
+    blocks: attn / mlp / vocab; train_step: optimizer). Forward AND
+    backward ops carry the scope (transposes keep the token), so each
+    share is that component's fwd+bwd+remat cost; "other" is embed,
+    grad-accum glue, casts and copies — the non-matmul slack the MFU
+    plateau hides."""
+    import threading
+
+    from dlrover_tpu.tpu_timer.xla_capture import (
+        bucket_by_scope,
+        capture_op_profile,
+    )
+
+    window_s = min(max(step_s * 1.5, 1.0), 10.0)
+    box = {}
+
+    def cap():
+        try:
+            box["ops"] = capture_op_profile(capture_s=window_s)
+        except Exception as e:  # noqa: BLE001 - breakdown is best-effort
+            box["err"] = f"{type(e).__name__}: {e}"[:120]
+
+    th = threading.Thread(target=cap, daemon=True)
+    th.start()
+    deadline = time.time() + window_s + 2.0
+    while time.time() < deadline:
+        state, m = step_fn(state, batch_d)
+        float(m["loss"])
+    th.join(timeout=60)
+    if th.is_alive():
+        # Abandoned capture thread: try to close its session so later
+        # phases (profiler_overhead) don't hit "profiler already
+        # active"; the stop may legitimately fail if the thread races
+        # it to the close.
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+        return {"mfu_breakdown_error": "capture did not finish in 60s"}
+    ops = box.get("ops") or []
+    shares = bucket_by_scope(ops, {
+        "attn": ("attn",),
+        "mlp": ("mlp",),
+        "vocab": ("vocab", "lm_head"),
+        "optimizer": ("optimizer",),
+    })
+    if not shares:
+        return {"mfu_breakdown_error": box.get("err", "no device ops")}
+    return {
+        "mfu_breakdown": {k: round(v, 3) for k, v in shares.items()}
     }
 
 
@@ -233,6 +327,8 @@ def ring_inner_ab_phase():
             try:
                 t = _timed_op(fn, q, iters, overhead)
                 out[f"ring_inner_{name}_ms_s{s}"] = round(t * 1e3, 2)
+            except PhaseTimeout:
+                raise  # one-shot alarm: must reach run_phase
             except Exception as e:
                 out[f"ring_inner_{name}_ms_s{s}"] = None
                 out[f"ring_inner_{name}_error_s{s}"] = (
@@ -271,6 +367,8 @@ def longctx_phase():
     out = {}
     peak = device_peak_flops()
     for seq, steps in ((32768, 3), (65536, 2)):
+        if seq > 32768 and time_left() < RESERVE_S + 120:
+            break  # 32k (the receipt VERDICT r4 #7 wants) is in hand
         batch = 1
         # attn_save: attention escapes remat (its re-run dominates the
         # remat bill at long context — measured 2212 -> 1808 ms/step at
@@ -305,6 +403,8 @@ def longctx_phase():
                     state, m = step_fn(state, bd)
                 float(m["loss"])
                 step_s = (_t.time() - t0) / steps
+            except PhaseTimeout:
+                raise  # one-shot alarm: must reach run_phase
             except Exception as e:
                 # The fallback must cover the TIMED steps too — a
                 # transient tunnel failure mid-measurement would
@@ -481,6 +581,8 @@ def moe_phase():
     out = {}
     batch, seq, steps = 8, 2048, 6
     for impl in ("dropless", "gshard"):
+        if impl == "gshard" and time_left() < RESERVE_S + 90:
+            break
         cfg = llama.TpuLMConfig(
             vocab_size=32000, embed_dim=1024, n_layers=16, n_heads=8,
             n_kv_heads=8, head_dim=128, mlp_dim=1024, dtype="bfloat16",
@@ -521,6 +623,26 @@ def moe_phase():
     return out
 
 
+def _moe_bench_tensors(e: int, seed: int, b=8, s=2048, d=1024, f=1024):
+    """The ONE set of layer-level MoE bench tensors (x, router, gate,
+    up, down) — shared by the crossover sweep and the ep proxy so their
+    numbers stay comparable by construction."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    kx, kr, kg, ku, kd = jax.random.split(jax.random.key(seed), 5)
+    x = jax.random.normal(kx, (b, s, d), jnp.bfloat16)
+    rw = jax.random.normal(kr, (d, e), jnp.float32) / 8
+    wg = (jax.random.normal(kg, (e, d, f), jnp.float32)
+          / np.sqrt(d)).astype(jnp.bfloat16)
+    wu = (jax.random.normal(ku, (e, d, f), jnp.float32)
+          / np.sqrt(d)).astype(jnp.bfloat16)
+    wd = (jax.random.normal(kd, (e, f, d), jnp.float32)
+          / np.sqrt(f)).astype(jnp.bfloat16)
+    return x, rw, wg, wu, wd
+
+
 def moe_crossover_sweep():
     """Layer-level fwd+bwd A/B across expert count and capacity factor:
     the evidence behind dropless-vs-gshard auto-selection. GShard's
@@ -530,23 +652,15 @@ def moe_crossover_sweep():
     wins (VERDICT r3 #3: selection must be evidence-based)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from dlrover_tpu.models import moe as moe_lib
 
-    b, s, d, f = 8, 2048, 1024, 1024
     overhead = _call_overhead()
     out = {}
     for e in (8, 16):
-        kx, kr, kg, ku, kd = jax.random.split(jax.random.key(e), 5)
-        x = jax.random.normal(kx, (b, s, d), jnp.bfloat16)
-        rw = jax.random.normal(kr, (d, e), jnp.float32) / 8
-        wg = (jax.random.normal(kg, (e, d, f), jnp.float32)
-              / np.sqrt(d)).astype(jnp.bfloat16)
-        wu = (jax.random.normal(ku, (e, d, f), jnp.float32)
-              / np.sqrt(d)).astype(jnp.bfloat16)
-        wd = (jax.random.normal(kd, (e, f, d), jnp.float32)
-              / np.sqrt(f)).astype(jnp.bfloat16)
+        if e == 16 and time_left() < RESERVE_S + 90:
+            break
+        x, rw, wg, wu, wd = _moe_bench_tensors(e, seed=e)
 
         def chain(layer_fn):
             def g(x):
@@ -587,7 +701,58 @@ def moe_crossover_sweep():
         ] < out[k]
     ]
     out["moe_dropless_wins_at"] = wins
+    out.update(moe_dropless_ep_proxy())
     return out
+
+
+def moe_dropless_ep_proxy():
+    """Single-chip hardware datum for the ragged-all-to-all ep path
+    (VERDICT r4 #3): run ``moe_mlp_dropless_ep`` under shard_map over a
+    1-sized ep axis on the real chip. The collective is degenerate (one
+    member) but the whole dispatch machinery — routing, sort, offset
+    bookkeeping, ragged exchange, grouped matmuls, mirrored combine —
+    runs exactly as on a real ep mesh, so the number is the path's
+    fixed overhead vs the single-device dropless core (the remaining
+    delta on a real mesh is wire time). Certified functionally on an
+    8-device ep mesh by tests/test_moe_dropless.py and the driver
+    dryrun (__graft_entry__.py dropless-ep mesh)."""
+    import jax
+
+    from dlrover_tpu.models import moe as moe_lib
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    e = 8
+    x, rw, wg, wu, wd = _moe_bench_tensors(e, seed=e)
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+
+    def ep_fn(x):
+        out, _ = moe_lib.moe_mlp_dropless_ep(
+            x, rw, wg, wu, wd, mesh, top_k=2, interpret=False
+        )
+        return out
+
+    def core_fn(x):
+        out, _ = moe_lib.moe_mlp_dropless(x, rw, wg, wu, wd, top_k=2)
+        return out
+
+    # Forward-only on BOTH sides (the ep dispatch is the object of the
+    # measurement, and forward/forward is the apples-to-apples pair;
+    # the sweep's fwd+bwd numbers live under moe_sweep_*).
+    try:
+        with mesh:
+            t_ep = _timed_op(ep_fn, x, 10, _call_overhead())
+        t_core = _timed_op(core_fn, x, 10, _call_overhead())
+    except PhaseTimeout:
+        raise  # the scheduler's one-shot alarm must reach run_phase
+    except Exception as exc:  # noqa: BLE001 - datum is best-effort
+        return {
+            "moe_dropless_ep1_proxy_error":
+                f"{type(exc).__name__}: {exc}"[:120]
+        }
+    return {
+        "moe_dropless_ep1_proxy_ms": round(t_ep * 1e3, 2),
+        "moe_dropless_core_fwd_ms": round(t_core * 1e3, 2),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -606,10 +771,6 @@ def decode_phase():
 
     from dlrover_tpu.models import llama
     from dlrover_tpu.models.generate import generate
-
-    import os
-
-    from dlrover_tpu.models.generate import _compiled_generate
 
     cfg = llama.TpuLMConfig(
         vocab_size=32000, embed_dim=1024, n_layers=16, n_heads=8,
@@ -653,7 +814,11 @@ def decode_phase():
             out["decode_hbm_bw_gbs"] * 1e9
         ) * 1e3
 
-    for batch in (1, 8, 32):
+    # Headline batch FIRST: if the budget dies mid-phase the cumulative
+    # line already holds decode_ms_per_token + decode_vs_roofline.
+    for batch in (8, 32, 1):
+        if batch != 8 and time_left() < RESERVE_S + 60:
+            break
         dec_s = run_once(batch)
         ms_tok = dec_s / new * 1e3
         suffix = "" if batch == 8 else f"_b{batch}"
@@ -671,15 +836,23 @@ def decode_phase():
     # A/B: the length-aware Pallas decode attention (opt-in) vs the
     # default padded-cache XLA path, at the headline batch. The pallas
     # kernel's sequential (batch, kv_head, block) grid loses here —
-    # the record keeps the evidence behind the XLA default.
-    os.environ["DLROVER_TPU_DECODE_ATTN"] = "pallas"
-    _compiled_generate.cache_clear()
-    dec_s = run_once(8)
-    os.environ.pop("DLROVER_TPU_DECODE_ATTN", None)
-    _compiled_generate.cache_clear()
-    out["decode_ms_per_token_pallas_attn"] = round(
-        dec_s / new * 1e3, 3
-    )
+    # the record keeps the evidence behind the XLA default. The env
+    # toggle is restored in a finally (advisor r4: a mid-A/B tunnel
+    # flake must not leak pallas into a phase retry); the impl is part
+    # of _compiled_generate's cache key, so no cache_clear is needed.
+    if time_left() > RESERVE_S + 60:
+        prev = os.environ.get("DLROVER_TPU_DECODE_ATTN")
+        try:
+            os.environ["DLROVER_TPU_DECODE_ATTN"] = "pallas"
+            dec_s = run_once(8)
+            out["decode_ms_per_token_pallas_attn"] = round(
+                dec_s / new * 1e3, 3
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("DLROVER_TPU_DECODE_ATTN", None)
+            else:
+                os.environ["DLROVER_TPU_DECODE_ATTN"] = prev
     return out
 
 
@@ -738,15 +911,35 @@ def _timed_op(fn, x, iters, overhead_s):
     f = jax.jit(scan_fn)
     float(f(x))  # compile
     best = 1e9
-    for _ in range(3):
+    for _ in range(_repeats()):
         t0 = time.time()
         float(f(x))
         best = min(best, time.time() - t0)
     return (best - overhead_s) / iters
 
 
+_OVERHEAD_CACHE = {}
+
+
 def _call_overhead():
-    """Fixed per-call cost of this chip/tunnel (RTT + dispatch)."""
+    """Fixed per-call cost of this chip/tunnel (RTT + dispatch).
+    Measured once and cached — every hardware phase needs it, and the
+    measurement itself costs ~4 round trips. The measured value also
+    scales the timing-loop repeat counts (_repeats): on a bad tunnel
+    day the budget buys fewer repeats, not lost phases."""
+    if "v" in _OVERHEAD_CACHE:
+        return _OVERHEAD_CACHE["v"]
+    _OVERHEAD_CACHE["v"] = v = _measure_call_overhead()
+    return v
+
+
+def _repeats(default: int = 3) -> int:
+    """Timing repeats per measurement, scaled by tunnel weather."""
+    ov = _OVERHEAD_CACHE.get("v", 0.0)
+    return 2 if ov > 0.6 else default
+
+
+def _measure_call_overhead():
     import jax
     import jax.numpy as jnp
 
@@ -1015,12 +1208,12 @@ def goodput_phase(platform: str):
     }
 
 
-def e2e_phase():
+def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
     TPU client — the e2e worker needs the chip."""
     import subprocess
-    import sys
+    import tempfile
 
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_e2e.py"
@@ -1028,16 +1221,37 @@ def e2e_phase():
     # File redirection, NOT pipes: the e2e job's detached grandchildren
     # (agent workers, multiprocessing resource trackers) inherit stdio
     # and can outlive the child — a captured pipe then never reaches
-    # EOF and subprocess.run hangs long after the benchmark finished.
-    import tempfile
-
+    # EOF and the wait hangs long after the benchmark finished. Own
+    # session + killpg on timeout: an orphaned e2e WORKER would keep
+    # holding the TPU chip and starve every later phase.
     with tempfile.TemporaryFile("w+") as out_f, tempfile.TemporaryFile(
         "w+"
     ) as err_f:
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, path], stdout=out_f, stderr=err_f,
-            timeout=900,
+            start_new_session=True,
         )
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"bench_e2e exceeded its {timeout_s:.0f}s slice "
+                "(process group killed to free the chip)"
+            )
+        finally:
+            # ANY exit with the group alive — own timeout, the
+            # scheduler's SIGALRM PhaseTimeout firing inside wait() —
+            # must killpg, or the orphaned e2e workers keep holding the
+            # chip and starve every later phase.
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
         out_f.seek(0)
         lines = out_f.read().strip().splitlines()
         if not lines:
@@ -1056,6 +1270,9 @@ def e2e_phase():
         "restore_s",
         "restore_state_mb",
         "restore_mb_per_s",
+        "restore_s_per_gb",
+        "canonical_state_mb",
+        "canonical_recovery_s",
         "replay_s",
         "replayed_steps",
         "autotuned_save_every_s",
@@ -1070,101 +1287,260 @@ def e2e_phase():
     return out
 
 
-def main():
-    result = {}
-    if not os.environ.get("BENCH_SKIP_E2E") and not os.environ.get(
-        "BENCH_FAST"
+# ---------------------------------------------------------------------------
+# Survivable orchestration: cumulative emits, budget, pruning
+# ---------------------------------------------------------------------------
+
+# Keys never pruned from an emitted line (the judge's headline set).
+_KEEP_KEYS = {
+    "metric", "value", "unit", "vs_baseline", "platform",
+    "skipped_phases", "elapsed_s", "budget_s",
+    "mfu_pct", "mfu_breakdown",
+    "ce_fused_chunked_vs_dense",
+    "measured_recovery_s", "e2e_machinery_recovery_s",
+    "e2e_restore_mb_per_s", "e2e_canonical_recovery_s",
+    "e2e_restore_s_per_gb", "e2e_restore_state_mb",
+    "e2e_goodput_pct",
+    "decode_ms_per_token", "decode_vs_roofline",
+    "decode_roofline_ms", "decode_hbm_bw_gbs",
+    "longctx_mfu_pct", "longctx_remat",
+    "moe_dropless_tokens_per_s", "moe_dropless_ep1_proxy_ms",
+    "profiler_overhead_pct",
+    "prev_round_diff",
+}
+
+# Pruned first → last once a line exceeds the tail budget.
+_DROP_ORDER = (
+    r"^ring_inner_",
+    r"^attn_(xla|pallas|ab)",
+    r"^moe_sweep_",
+    r"^(goodput_mtbf|autotuned_cadence_mtbf)",
+    r"^decode_.*_b(1|32)$",
+    r"^decode_(prompt_len|new_tokens|batch)",
+    r"^profiler_capture",
+    r"_error$|_timeout$",
+    r"^(ckpt_|raw_run_goodput|replay_s$|step_time_s|tokens_per_s)",
+    r"^e2e_(detect|runtime|replay|replayed|autotuned|effective"
+    r"|goodput_at|restore_s$|succeeded)",
+    r"^longctx_(step|tokens|seq)",
+    r"^compute_",
+    r"^(model_params_m|assumed_mtbf|autotuned_save|goodput_at_60s"
+    r"|attn_pallas_speedup)",
+    r"^moe_(gshard|params|active|dropless_step|dropless_mfu"
+    r"|gshard_mfu|dropless_wins)",
+)
+
+_TAIL_LIMIT = 1900  # driver tail capture is 2000 chars; stay inside
+
+
+def _prune(result: dict) -> dict:
+    """Drop detail keys (in _DROP_ORDER) until the JSON line fits the
+    driver's tail capture; _KEEP_KEYS survive everything."""
+    out = dict(result)
+    if len(json.dumps(out)) <= _TAIL_LIMIT:
+        return out
+    for pattern in _DROP_ORDER:
+        rx = re.compile(pattern)
+        for key in [k for k in out if rx.search(k)]:
+            if key in _KEEP_KEYS:
+                continue
+            del out[key]
+        if len(json.dumps(out)) <= _TAIL_LIMIT:
+            return out
+    # Still too big: shed non-keep keys wholesale, longest value first.
+    for key in sorted(
+        [k for k in out if k not in _KEEP_KEYS],
+        key=lambda k: -len(json.dumps(out[k])),
     ):
-        try:
-            result.update(e2e_phase())
-        except Exception as e:  # pragma: no cover - bench resilience
-            result["e2e_error"] = f"{type(e).__name__}: {e}"[:200]
+        del out[key]
+        if len(json.dumps(out)) <= _TAIL_LIMIT:
+            return out
+    # Last resort: even headline aggregates go, biggest first.
+    for key in ("prev_round_diff", "mfu_breakdown", "skipped_phases"):
+        out.pop(key, None)
+        if len(json.dumps(out)) <= _TAIL_LIMIT:
+            return out
+    return out
 
-    import jax
 
-    def run_phase(name, fn):
-        # One retry: the tunnel's remote Pallas compile helper fails
-        # transiently ("response body closed before all bytes were
-        # read"); losing a whole phase's numbers to that is worse than
-        # a minute of rerun.
+def emit(result: dict):
+    """Print the cumulative result as ONE pruned JSON line. Called after
+    every phase: the driver's tail capture always ends with the newest
+    superset, so a timeout loses only unfinished phases (and the
+    round-over-round diff is refreshed on every line, not just the
+    final one)."""
+    result["elapsed_s"] = round(time.time() - _T0, 1)
+    result["prev_round_diff"] = prev_round_diff(result)
+    line = json.dumps(_prune(result))
+    print(line, flush=True)
+
+
+class PhaseTimeout(Exception):
+    pass
+
+
+def run_phase(result, name, fn, est_s, cap_s=None):
+    """Run one phase under the global budget.
+
+    Skips (recording the name) when the remaining budget can't plausibly
+    fit the estimate; arms a SIGALRM backstop at the phase's slice so a
+    hung tunnel call cannot eat the rest of the run; retries once on
+    transient failure if the budget still allows. Emits the cumulative
+    line whatever happens."""
+    remaining = time_left() - RESERVE_S
+    if remaining < est_s * 0.6:
+        result.setdefault("skipped_phases", []).append(name)
+        emit(result)
+        return
+    # Default slice: 2.5x the estimate, never the whole remaining
+    # budget — one wedged tunnel call must cost ONE phase, not every
+    # phase after it (the round-4 total-loss mode).
+    cap = max(int(min(cap_s or est_s * 2.5, remaining)), 30)
+
+    def _alarm(signum, frame):
+        raise PhaseTimeout(f"{name} exceeded its {cap}s slice")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(cap)
+    try:
         for attempt in (1, 2):
             try:
                 result.update(fn())
-                return
+                break
+            except PhaseTimeout as e:
+                result[f"{name}_timeout"] = str(e)
+                break
             except Exception as e:  # pragma: no cover - bench resilience
                 err = f"{type(e).__name__}: {e}"[:200]
-                if attempt == 2:
+                # One retry: the tunnel's remote Pallas compile helper
+                # fails transiently ("response body closed before all
+                # bytes were read"); losing a phase to that is worse
+                # than a rerun — but only if the budget still fits one.
+                if attempt == 2 or time_left() - RESERVE_S < est_s * 0.6:
                     result[f"{name}_error"] = err
-                else:
-                    print(
-                        f"# phase {name} attempt 1 failed ({err}); "
-                        "retrying",
-                        file=__import__("sys").stderr,
-                    )
+                    break
+                print(
+                    f"# phase {name} attempt 1 failed ({err}); retrying",
+                    file=sys.stderr,
+                )
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    emit(result)
+
+
+def main():
+    result = {
+        # Schema keys first so even the earliest partial line satisfies
+        # the driver's {"metric", "value", "unit", "vs_baseline"}
+        # contract (value stays null until the goodput phase lands).
+        "metric": "goodput_under_preemption",
+        "value": None,
+        "unit": "%",
+        "vs_baseline": None,
+        "budget_s": BUDGET_S,
+        "skipped_phases": [],
+    }
+    emit(result)
+
+    fast = bool(os.environ.get("BENCH_FAST"))
+    if not os.environ.get("BENCH_SKIP_E2E") and not fast:
+        # Before the parent touches the TPU client: the e2e worker needs
+        # the chip. Highest-value phase, but capped so a wedged agent
+        # can't eat the whole budget.
+        run_phase(
+            result, "e2e", lambda: e2e_phase(
+                timeout_s=min(600.0, max(time_left() - 600.0, 240.0))
+            ),
+            est_s=180, cap_s=620,
+        )
+
+    import jax
 
     platform = jax.devices()[0].platform
-    if platform != "cpu" and not os.environ.get("BENCH_FAST"):
-        run_phase("compute", compute_phase)
-        run_phase("attn_ab", attention_ab_phase)
-        run_phase("ce_ab", ce_ab_phase)
-        run_phase("ring_inner_ab", ring_inner_ab_phase)
-        run_phase("moe", moe_phase)
-        run_phase("decode", decode_phase)
-        run_phase("longctx", longctx_phase)
-        run_phase("profiler_overhead", profiler_overhead_phase)
-    goodput = goodput_phase(platform)
-    goodput.update(result)
-    goodput["prev_round_diff"] = prev_round_diff(goodput)
-    print(json.dumps(goodput))
+    run_phase(
+        result, "goodput", lambda: goodput_phase(platform),
+        est_s=150, cap_s=420,
+    )
+    if platform != "cpu" and not fast:
+        # Information-value order (VERDICT r4 #1c): headline compute +
+        # CE + decode + longctx before the long tail.
+        run_phase(result, "compute", compute_phase, est_s=150)
+        run_phase(result, "ce_ab", ce_ab_phase, est_s=120)
+        run_phase(result, "decode", decode_phase, est_s=200)
+        run_phase(result, "longctx", longctx_phase, est_s=220)
+        run_phase(result, "moe", moe_phase, est_s=260)
+        run_phase(result, "attn_ab", attention_ab_phase, est_s=120)
+        run_phase(
+            result, "ring_inner_ab", ring_inner_ab_phase, est_s=140
+        )
+        run_phase(
+            result, "profiler_overhead", profiler_overhead_phase,
+            est_s=180,
+        )
+    emit(result)
+    # Hard exit: nothing (jax atexit, stray threads) may print after the
+    # final line — the driver parses the LAST line of the tail.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 def prev_round_diff(now: dict) -> dict:
-    """Headline metrics vs the newest BENCH_r*.json so regressions are
-    loud in the artifact itself (round 3's 12.95s->17.29s recovery
-    regression went unnoticed because nothing diffed). The driver's
-    capture may truncate the stored JSON, so keys are regex-extracted
-    rather than parsed."""
+    """Headline metrics vs the newest BENCH_r*.json THAT HAS DATA, so
+    regressions are loud in the artifact itself (round 3's
+    12.95s->17.29s recovery regression went unnoticed because nothing
+    diffed; round 4's artifact was empty, so the newest file alone
+    can't be trusted to hold numbers). The driver's capture may
+    truncate the stored JSON, so keys are regex-extracted rather than
+    parsed."""
     import glob
-    import re
 
     files = glob.glob("BENCH_r*.json")
-    if not files:
-        return {}
 
     def round_no(p):  # numeric: lexicographic puts r10 before r9
         m = re.search(r"BENCH_r(\d+)\.json$", p)
         return int(m.group(1)) if m else -1
 
-    newest = max(files, key=round_no)
-    try:
-        text = open(newest).read()
-    except OSError:
-        return {}
     keys = (
         "mfu_pct",
         "measured_recovery_s",
+        "e2e_machinery_recovery_s",
+        "e2e_restore_mb_per_s",
+        "e2e_restore_s_per_gb",
+        "e2e_canonical_recovery_s",
         "e2e_replay_s",
         "ckpt_restore_s",
         "e2e_goodput_pct",
         "decode_ms_per_token",
+        "decode_vs_roofline",
+        "longctx_mfu_pct",
         "longctx_tokens_per_s",
         "ce_fused_chunked_vs_dense",
         "moe_dropless_tokens_per_s",
     )
-    out = {"vs_file": os.path.basename(newest)}
-    for key in keys:
-        if key not in now or now[key] is None:
+    for path in sorted(files, key=round_no, reverse=True):
+        try:
+            text = open(path).read()
+        except OSError:
             continue
-        m = re.search(rf'\\?"{key}\\?": ([-0-9.]+)', text)
-        if not m:
-            continue
-        prev = float(m.group(1))
-        out[key] = {
-            "prev": prev,
-            "now": now[key],
-            "delta": round(float(now[key]) - prev, 3),
-        }
-    return out
+        out = {"vs_file": os.path.basename(path)}
+        for key in keys:
+            if key not in now or now[key] is None:
+                continue
+            m = re.search(rf'\\?"{key}\\?": ([-0-9.]+)', text)
+            if not m:
+                continue
+            prev = float(m.group(1))
+            # {prev, delta} only: "now" is already a headline key on the
+            # same line, and the diff must fit the 2000-char tail.
+            out[key] = {
+                "prev": prev,
+                "delta": round(float(now[key]) - prev, 3),
+            }
+        if len(out) > 1:
+            return out
+    return {}
 
 
 if __name__ == "__main__":

@@ -323,9 +323,13 @@ class ElasticAgent:
     def _stop_workers(self, timeout: float = 15.0, post_mortem: bool = False):
         if post_mortem:
             # Failure/hang stop: SIGUSR2 makes workers dump all-thread
-            # stacks into their logs (a worker wedged in a collective
-            # tells us where), then a grace period lets faulthandler
-            # finish writing before SIGTERM lands.
+            # PYTHON stacks into their logs (a worker wedged in a
+            # collective tells us where), then a grace period lets
+            # faulthandler finish writing before SIGTERM lands. A
+            # worker wedged inside libtpu/XLA C++ shows one opaque
+            # Python line, so the agent ALSO captures native stacks
+            # out-of-process (ptrace + libunwind, the reference's
+            # gdb-orchestration role) and appends them to the same log.
             dumped = False
             for w in self._workers:
                 if w.process.poll() is None:
@@ -336,6 +340,23 @@ class ElasticAgent:
                         pass
             if dumped:
                 time.sleep(0.5)
+            for w in self._workers:
+                if w.process.poll() is not None:
+                    continue
+                try:
+                    from dlrover_tpu.tpu_timer.native_stack import (
+                        sample_native_stacks,
+                    )
+
+                    text = sample_native_stacks(w.process.pid)
+                except Exception:  # noqa: BLE001 - diagnosis best-effort
+                    text = None
+                if text and w.log_file:
+                    try:
+                        w.log_file.write(text.encode())
+                        w.log_file.flush()
+                    except (OSError, ValueError):
+                        pass
         for w in self._workers:
             if w.process.poll() is None:
                 try:

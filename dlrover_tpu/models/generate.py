@@ -131,9 +131,14 @@ def _fused_mlp(config, p, x):
     return residual + out.astype(residual.dtype)
 
 
-def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
+def _layer_decode(
+    config, p, x, positions, k_cache, v_cache, cache_len,
+    attn_impl=None,
+):
     """One decoder block over [b, sq] new tokens with cache append.
-    Returns (x, new_k_cache, new_v_cache)."""
+    Returns (x, new_k_cache, new_v_cache). ``attn_impl`` ("pallas" |
+    "xla") is resolved by the caller; None falls back to the env knob
+    (direct callers / tests)."""
     residual = x
     if "wqkv" in p:
         q, k, v = _fused_qkv(config, p, x, positions)
@@ -153,7 +158,7 @@ def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
     if (
         q.shape[1] == 1
         and block_k is not None
-        and _decode_attn_impl() == "pallas"
+        and (attn_impl or _decode_attn_impl()) == "pallas"
     ):
         # Single-token step: the length-aware kernel reads only the
         # filled cache blocks (ops/decode_attention.py).
@@ -189,7 +194,9 @@ def _layer_decode(config, p, x, positions, k_cache, v_cache, cache_len):
     return x, k_cache, v_cache
 
 
-def _forward_with_cache(config, params, tokens, cache: DecodeCache):
+def _forward_with_cache(
+    config, params, tokens, cache: DecodeCache, attn_impl=None
+):
     """Run [b, sq] tokens through all layers, appending to the cache.
     Returns (logits of the LAST position [b, vocab], new cache)."""
     b, sq = tokens.shape
@@ -201,7 +208,8 @@ def _forward_with_cache(config, params, tokens, cache: DecodeCache):
     def body(carry, layer_in):
         pl, k_c, v_c = layer_in
         y, k_c, v_c = _layer_decode(
-            config, pl, carry, positions, k_c, v_c, cache.length
+            config, pl, carry, positions, k_c, v_c, cache.length,
+            attn_impl=attn_impl,
         )
         return y, (k_c, v_c)
 
@@ -225,10 +233,14 @@ def _compiled_generate(
     max_new_tokens: int,
     max_len: int,
     temperature: float,
+    attn_impl: str = "xla",
 ):
-    """One compiled program per (config, shapes, temperature) — repeat
-    generate() calls reuse it (jit caches key on the function object,
-    which must therefore be cached itself)."""
+    """One compiled program per (config, shapes, temperature,
+    attn_impl) — repeat generate() calls reuse it (jit caches key on
+    the function object, which must therefore be cached itself). The
+    decode-attention impl is an EXPLICIT cache-key argument: generate()
+    resolves the DLROVER_TPU_DECODE_ATTN env var per call, so toggling
+    it takes effect without cache_clear() (advisor r4)."""
 
     def pick(logits, rng):
         if temperature <= 0.0:
@@ -261,7 +273,9 @@ def _compiled_generate(
             "layers": _fuse_decode_params(config, params["layers"]),
         }
         cache = init_cache(config, batch, max_len)
-        logits, cache = _forward_with_cache(config, params, prompt, cache)
+        logits, cache = _forward_with_cache(
+            config, params, prompt, cache, attn_impl=attn_impl
+        )
         rng, first_key = jax.random.split(rng)
         first = pick(logits, first_key)
 
@@ -269,7 +283,7 @@ def _compiled_generate(
             cache, tok, rng = carry
             rng, sub = jax.random.split(rng)
             logits, cache = _forward_with_cache(
-                config, params, tok[:, None], cache
+                config, params, tok[:, None], cache, attn_impl=attn_impl
             )
             nxt = pick(logits, sub)
             return (cache, nxt, rng), tok
@@ -308,7 +322,8 @@ def generate(
         raise ValueError("temperature > 0 requires an explicit rng key")
     rng = rng if rng is not None else jax.random.key(0)
     run = _compiled_generate(
-        config, b, max_new_tokens, max_len, float(temperature)
+        config, b, max_new_tokens, max_len, float(temperature),
+        attn_impl=_decode_attn_impl(),
     )
     tokens, cache = run(params, prompt, rng)
     return GenerateResult(tokens=tokens, cache=cache)

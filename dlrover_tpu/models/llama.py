@@ -348,6 +348,11 @@ def _moe_resolve_impl(config) -> str:
 def mlp_block(config: TpuLMConfig, p, x):
     """Residual MLP (dense or MoE). Returns (x, aux). Shared with the
     decode path."""
+    with jax.named_scope("mlp"):
+        return _mlp_block_inner(config, p, x)
+
+
+def _mlp_block_inner(config: TpuLMConfig, p, x):
     cdt = config.compute_dtype
     residual = x
     hx = rms_norm(x, p["mlp_norm"]).astype(cdt)
@@ -406,10 +411,14 @@ def transformer_layer(
     attn_fn = attention_fn or dot_product_attention
 
     residual = x
-    q, k, v = attention_qkv(config, p, x, positions)
-    attn = attn_fn(q, k, v, causal=True,
-                   q_positions=positions, kv_positions=positions)
-    x = attention_out(config, p, attn, residual)
+    # named_scope: the scope lands in every op's trace metadata (tf_op),
+    # forward AND backward — the basis of the bench's mfu_breakdown
+    # (tpu_timer/xla_capture.bucket_by_scope).
+    with jax.named_scope("attn"):
+        q, k, v = attention_qkv(config, p, x, positions)
+        attn = attn_fn(q, k, v, causal=True,
+                       q_positions=positions, kv_positions=positions)
+        x = attention_out(config, p, attn, residual)
     return mlp_block(config, p, x)
 
 
@@ -431,16 +440,19 @@ def final_hidden(config, params, x):
 
 
 def unembed(config, params, x):
-    x = final_hidden(config, params, x)
-    # bf16 einsum + separate f32 cast measures ~2ms/step better than a
-    # preferred_element_type=f32 matmul here: XLA fuses the convert into
-    # the loss consumers, so the bf16 intermediate halves the HBM write.
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"].astype(config.compute_dtype)
-    )
-    return with_logical_constraint(
-        logits.astype(jnp.float32), ("batch", "seq", "vocab")
-    )
+    with jax.named_scope("vocab"):
+        x = final_hidden(config, params, x)
+        # bf16 einsum + separate f32 cast measures ~2ms/step better
+        # than a preferred_element_type=f32 matmul here: XLA fuses the
+        # convert into the loss consumers, so the bf16 intermediate
+        # halves the HBM write.
+        logits = jnp.einsum(
+            "bsd,dv->bsv",
+            x, params["lm_head"].astype(config.compute_dtype),
+        )
+        return with_logical_constraint(
+            logits.astype(jnp.float32), ("batch", "seq", "vocab")
+        )
 
 
 def run_layer_stack(
@@ -502,15 +514,19 @@ def run_layer_stack(
         )
 
         def out_mlp(p, attn, residual):
-            y = attention_out(config, p, attn, residual)
+            with jax.named_scope("attn"):
+                y = attention_out(config, p, attn, residual)
             return mlp_block(config, p, y)
 
         ckpt_out_mlp = jax.checkpoint(out_mlp, policy=flank_policy)
 
         def body(carry, pl):
-            q, k, v = ckpt_qkv(pl, carry, positions)
-            attn = attn_fn(q, k, v, causal=True,
-                           q_positions=positions, kv_positions=positions)
+            with jax.named_scope("attn"):
+                q, k, v = ckpt_qkv(pl, carry, positions)
+                attn = attn_fn(
+                    q, k, v, causal=True,
+                    q_positions=positions, kv_positions=positions,
+                )
             return ckpt_out_mlp(pl, attn, carry)
 
     else:
@@ -642,53 +658,68 @@ def _fused_ce_applicable(config) -> bool:
     return all(dict(mesh.shape).get(a, 1) == 1 for a in axes)
 
 
+def resolve_ce_path(config, n_tokens: int) -> str:
+    """"fused" | "dense" — the CE decision ``loss_fn`` makes for a
+    batch of ``n_tokens`` tokens, exposed so the driver dryrun
+    (__graft_entry__.py) can LOG which CE path each certified mesh
+    executed (VERDICT r4 #8). Mesh-dependent: call under the same
+    ``with mesh:`` the step runs in.
+
+    The chunked fused CE runs at ~0.99-1.07x dense on v5e (same three
+    matmuls; gradients computed in the forward, see ops/fused_ce.py)
+    while never materializing the [N, V] logits. "auto" engages it
+    once the f32 logits pass 2 GiB — at that scale the memory freed
+    matters (it is what lets the attn_save remat policy fit at 32k
+    tokens) and the time cost is a wash; below it, dense keeps its
+    measured edge on the flagship MFU path."""
+    mode = _fused_ce_mode()
+    logits_bytes = n_tokens * config.vocab_size * 4
+    use_fused = mode == "on" or (
+        mode == "auto" and logits_bytes > 2 * 1024**3
+    )
+    if use_fused and _fused_ce_applicable(config):
+        return "fused"
+    return "dense"
+
+
 def loss_fn(config, params, batch, attention_fn=None):
     """batch: {"tokens": [b,s+1]} — next-token LM loss.
 
     Uses the fused blockwise CE (ops/fused_ce.py) whenever applicable so
     the [b, s, vocab] f32 logits never materialize; falls back to
-    ``forward`` + ``cross_entropy`` for pipelined or vocab-sharded runs.
-    Set DLROVER_TPU_FUSED_CE=off to force the unfused path.
+    ``forward`` + ``cross_entropy`` for pipelined or vocab-sharded runs
+    (see ``resolve_ce_path``). Set DLROVER_TPU_FUSED_CE=off to force
+    the unfused path.
     """
     tokens = batch["tokens"][:, :-1]
     targets = batch["tokens"][:, 1:]
-    # The chunked fused CE runs at ~0.99-1.07x dense on v5e (same three
-    # matmuls; gradients computed in the forward, see ops/fused_ce.py)
-    # while never materializing the [N, V] logits. "auto" engages it
-    # once the f32 logits pass 2 GiB — at that scale the memory freed
-    # matters (it is what lets the attn_save remat policy fit at 32k
-    # tokens) and the time cost is a wash; below it, dense keeps its
-    # measured edge on the flagship MFU path.
-    mode = _fused_ce_mode()
-    logits_bytes = tokens.size * config.vocab_size * 4
-    use_fused = mode == "on" or (
-        mode == "auto" and logits_bytes > 2 * 1024**3
-    )
-    if use_fused and _fused_ce_applicable(config):
+    if resolve_ce_path(config, tokens.size) == "fused":
         from dlrover_tpu.ops.fused_ce import fused_cross_entropy
 
         x, aux = forward_hidden(
             config, params, tokens, attention_fn=attention_fn
         )
-        h = final_hidden(config, params, x)
-        # Long sequences cap the CE row chunk at 4096: the 8192-row
-        # tile pushed the whole-program TPU compile over the edge when
-        # combined with the attn_save remat policy (measured v5e:
-        # compile-helper failure at 32k tokens; 4096 compiles and times
-        # identically there, and at long context the CE is ~2% of the
-        # step). Short-sequence large-batch runs keep the measured-
-        # fastest auto chunk.
-        ce = fused_cross_entropy(
-            h,
-            params["lm_head"].astype(config.compute_dtype),
-            targets,
-            batch.get("mask"),
-            block_rows=4096 if tokens.shape[1] >= 32768 else None,
-        )
+        with jax.named_scope("vocab"):
+            h = final_hidden(config, params, x)
+            # Long sequences cap the CE row chunk at 4096: the 8192-row
+            # tile pushed the whole-program TPU compile over the edge
+            # when combined with the attn_save remat policy (measured
+            # v5e: compile-helper failure at 32k tokens; 4096 compiles
+            # and times identically there, and at long context the CE is
+            # ~2% of the step). Short-sequence large-batch runs keep the
+            # measured-fastest auto chunk.
+            ce = fused_cross_entropy(
+                h,
+                params["lm_head"].astype(config.compute_dtype),
+                targets,
+                batch.get("mask"),
+                block_rows=4096 if tokens.shape[1] >= 32768 else None,
+            )
     else:
         logits, aux = forward(
             config, params, tokens, attention_fn=attention_fn
         )
-        ce = cross_entropy(logits, targets, batch.get("mask"))
+        with jax.named_scope("vocab"):
+            ce = cross_entropy(logits, targets, batch.get("mask"))
     loss = ce + config.moe_aux_weight * aux
     return loss, {"ce": ce, "aux": aux}

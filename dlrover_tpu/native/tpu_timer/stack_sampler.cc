@@ -1,0 +1,209 @@
+// stack_sampler: out-of-process NATIVE stack capture for hung workers.
+//
+// Parity: reference xpu_timer orchestrates gdb/py-spy dumps of arbitrary
+// training processes from its per-node daemon
+// (xpu_timer/server/hosting_service_server_client.cc, RPC surface
+// xpu_timer/protos/hosting_service.proto:14-250). This image ships
+// neither gdb nor py-spy, so the capability is built directly:
+// ptrace-attach to every thread of the target and unwind its USER-SPACE
+// stack with libunwind-ptrace — the C/C++ frames a faulthandler dump
+// cannot see (a worker wedged inside libtpu/XLA shows Python blocked in
+// one opaque line; the interesting frames are native — VERDICT r4 #4).
+//
+// The distro ships libunwind runtime libraries but no headers, so the
+// small, ABI-stable slice of the API used here is declared locally and
+// resolved with dlopen/dlsym at runtime (x86_64 symbol prefix
+// _Ux86_64_). Usage:
+//
+//     stack_sampler <pid> [max_frames]
+//
+// Output (one block per thread, faulthandler-adjacent format so the
+// analysis tool folds it into the same histograms):
+//
+//     Native thread <tid> (most recent call first):
+//       #0 0x00007f... clock_nanosleep+0x47
+//       ...
+//
+// Exit code 0 if at least one thread unwound, 1 otherwise. The target
+// keeps running: each thread is attached, walked, detached (SIGSTOP /
+// SIGCONT window of a few ms per thread — the same disturbance py-spy
+// imposes).
+
+#include <cxxabi.h>
+#include <dirent.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ptrace.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---- libunwind ABI slice (no headers in the image) ----
+using unw_word = unsigned long;
+struct UnwCursor {
+  // Real unw_cursor_t is 127 words; oversize for safety.
+  unw_word opaque[512];
+};
+using unw_addr_space_t = void*;
+constexpr int kUnwRegIp = 16;  // UNW_X86_64_RIP == UNW_REG_IP on x86_64
+
+using create_addr_space_fn = unw_addr_space_t (*)(void* accessors,
+                                                  int byteorder);
+using destroy_addr_space_fn = void (*)(unw_addr_space_t);
+using init_remote_fn = int (*)(UnwCursor*, unw_addr_space_t, void*);
+using step_fn = int (*)(UnwCursor*);
+using get_reg_fn = int (*)(UnwCursor*, int, unw_word*);
+using get_proc_name_fn = int (*)(UnwCursor*, char*, size_t, unw_word*);
+using upt_create_fn = void* (*)(pid_t);
+using upt_destroy_fn = void (*)(void*);
+
+struct Unwind {
+  create_addr_space_fn create_addr_space;
+  destroy_addr_space_fn destroy_addr_space;
+  init_remote_fn init_remote;
+  step_fn step;
+  get_reg_fn get_reg;
+  get_proc_name_fn get_proc_name;
+  void* upt_accessors;
+  upt_create_fn upt_create;
+  upt_destroy_fn upt_destroy;
+};
+
+bool load_unwind(Unwind* u) {
+  // libunwind-ptrace links against libunwind-generic; load the arch
+  // library RTLD_GLOBAL first so _UPT symbols resolve.
+  void* arch = dlopen("libunwind-x86_64.so.8", RTLD_NOW | RTLD_GLOBAL);
+  if (!arch) {
+    fprintf(stderr, "stack_sampler: %s\n", dlerror());
+    return false;
+  }
+  void* upt = dlopen("libunwind-ptrace.so.0", RTLD_NOW | RTLD_GLOBAL);
+  if (!upt) {
+    fprintf(stderr, "stack_sampler: %s\n", dlerror());
+    return false;
+  }
+  u->create_addr_space = reinterpret_cast<create_addr_space_fn>(
+      dlsym(arch, "_Ux86_64_create_addr_space"));
+  u->destroy_addr_space = reinterpret_cast<destroy_addr_space_fn>(
+      dlsym(arch, "_Ux86_64_destroy_addr_space"));
+  u->init_remote = reinterpret_cast<init_remote_fn>(
+      dlsym(arch, "_Ux86_64_init_remote"));
+  u->step = reinterpret_cast<step_fn>(dlsym(arch, "_Ux86_64_step"));
+  u->get_reg = reinterpret_cast<get_reg_fn>(
+      dlsym(arch, "_Ux86_64_get_reg"));
+  u->get_proc_name = reinterpret_cast<get_proc_name_fn>(
+      dlsym(arch, "_Ux86_64_get_proc_name"));
+  u->upt_accessors = dlsym(upt, "_UPT_accessors");
+  u->upt_create =
+      reinterpret_cast<upt_create_fn>(dlsym(upt, "_UPT_create"));
+  u->upt_destroy =
+      reinterpret_cast<upt_destroy_fn>(dlsym(upt, "_UPT_destroy"));
+  if (!u->create_addr_space || !u->init_remote || !u->step ||
+      !u->get_reg || !u->get_proc_name || !u->upt_accessors ||
+      !u->upt_create || !u->upt_destroy) {
+    fprintf(stderr, "stack_sampler: missing libunwind symbols\n");
+    return false;
+  }
+  return true;
+}
+
+std::string demangle(const char* name) {
+  int status = 0;
+  char* out = abi::__cxa_demangle(name, nullptr, nullptr, &status);
+  if (status == 0 && out) {
+    std::string s(out);
+    free(out);
+    return s;
+  }
+  return name;
+}
+
+std::vector<pid_t> list_tids(pid_t pid) {
+  std::vector<pid_t> tids;
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%d/task", pid);
+  DIR* dir = opendir(path);
+  if (!dir) return tids;
+  while (dirent* ent = readdir(dir)) {
+    if (ent->d_name[0] == '.') continue;
+    tids.push_back(static_cast<pid_t>(atol(ent->d_name)));
+  }
+  closedir(dir);
+  return tids;
+}
+
+// Attach and wait for the stop; __WALL covers clone threads.
+bool attach(pid_t tid) {
+  if (ptrace(PTRACE_ATTACH, tid, nullptr, nullptr) != 0) return false;
+  int status = 0;
+  for (int i = 0; i < 1000; ++i) {
+    pid_t r = waitpid(tid, &status, __WALL);
+    if (r == tid && WIFSTOPPED(status)) return true;
+    if (r < 0 && errno != EINTR) break;
+  }
+  ptrace(PTRACE_DETACH, tid, nullptr, nullptr);
+  return false;
+}
+
+int walk_thread(const Unwind& u, pid_t tid, int max_frames) {
+  if (!attach(tid)) {
+    fprintf(stderr, "stack_sampler: attach %d failed: %s\n", tid,
+            strerror(errno));
+    return 0;
+  }
+  int frames = 0;
+  unw_addr_space_t as = u.create_addr_space(u.upt_accessors, 0);
+  void* ui = as ? u.upt_create(tid) : nullptr;
+  if (ui) {
+    UnwCursor cursor;
+    memset(&cursor, 0, sizeof(cursor));
+    if (u.init_remote(&cursor, as, ui) == 0) {
+      printf("Native thread %d (most recent call first):\n", tid);
+      do {
+        unw_word ip = 0;
+        if (u.get_reg(&cursor, kUnwRegIp, &ip) != 0) break;
+        char name[512];
+        unw_word off = 0;
+        if (u.get_proc_name(&cursor, name, sizeof(name), &off) == 0) {
+          printf("  #%d 0x%016lx %s+0x%lx\n", frames, ip,
+                 demangle(name).c_str(), off);
+        } else {
+          printf("  #%d 0x%016lx ??\n", frames, ip);
+        }
+        ++frames;
+      } while (frames < max_frames && u.step(&cursor) > 0);
+      printf("\n");
+    }
+    u.upt_destroy(ui);
+  }
+  if (as) u.destroy_addr_space(as);
+  ptrace(PTRACE_DETACH, tid, nullptr, nullptr);
+  return frames;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <pid> [max_frames]\n", argv[0]);
+    return 2;
+  }
+  pid_t pid = static_cast<pid_t>(atol(argv[1]));
+  int max_frames = argc > 2 ? atoi(argv[2]) : 64;
+  Unwind u;
+  if (!load_unwind(&u)) return 1;
+  int total = 0;
+  for (pid_t tid : list_tids(pid)) {
+    total += walk_thread(u, tid, max_frames);
+  }
+  fflush(stdout);
+  return total > 0 ? 0 : 1;
+}

@@ -572,6 +572,15 @@ def _multi_device_mesh_active() -> bool:
         return False
 
 
+def resolve_impl(impl: Optional[str] = None) -> str:
+    """The fused-CE sub-impl auto-selection: "chunked" single-device,
+    the GSPMD-safe vocab-scan "xla" path under a multi-device mesh.
+    Mesh-dependent — call under the active ``with mesh:``."""
+    if impl is not None:
+        return impl
+    return "xla" if _multi_device_mesh_active() else "chunked"
+
+
 def fused_cross_entropy(
     x,
     w,
@@ -593,10 +602,12 @@ def fused_cross_entropy(
 
     impl: "chunked" | "pallas" | "xla" | None. Auto picks "chunked"
     (dense-speed, O(block_rows*V) memory) except under a multi-device
-    mesh, where the vocab-scan "xla" path keeps GSPMD shardings intact.
+    mesh, where the vocab-scan "xla" path keeps GSPMD shardings intact
+    (``resolve_impl`` is the selection, shared with the driver dryrun's
+    per-mesh CE logging).
     """
     if impl is None:
-        impl = "xla" if _multi_device_mesh_active() else "chunked"
+        impl = resolve_impl()
     d = x.shape[-1]
     n = int(np.prod(x.shape[:-1]))
     x2 = x.reshape(n, d)

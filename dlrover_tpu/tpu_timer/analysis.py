@@ -409,12 +409,19 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "stacks":
+        from dlrover_tpu.tpu_timer.native_stack import parse_native_dumps
+
         stacks: List[List[str]] = []
         for path in args.logs:
             with open(path, errors="replace") as f:
-                stacks.extend(parse_faulthandler_dumps(f.read()))
+                text = f.read()
+            stacks.extend(parse_faulthandler_dumps(text))
+            # Native stacks the agent captured out-of-process (ptrace +
+            # libunwind) live in the same logs; fold them into the same
+            # histogram so a libtpu/XLA hang names its C++ frame.
+            stacks.extend(parse_native_dumps(text))
         if not stacks:
-            print("no faulthandler dumps found", file=sys.stderr)
+            print("no stack dumps found", file=sys.stderr)
             return 1
         if args.folded:
             for stack, count in sorted(fold_stacks(stacks).items()):

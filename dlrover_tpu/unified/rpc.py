@@ -23,11 +23,16 @@ Payloads are pickled — numpy arrays (and anything picklable) ship
 as-is; device arrays should be pulled to host first (np.asarray).
 
 **Trust boundary**: pickle executes code on load, so every connection
-must prove job membership BEFORE its first frame is parsed. The client
-sends a fixed-length preamble (magic + sha256 of the per-job secret)
-immediately after connect; the server reads exactly that many bytes,
-compares in constant time, and drops the connection on mismatch —
-nothing attacker-controlled ever reaches ``pickle.loads``. The secret
+must prove job membership BEFORE its first frame is parsed. On accept
+the server sends a fresh random nonce; the client answers with
+HMAC(sha256(token), nonce). The server verifies in constant time and
+drops the connection on mismatch — nothing attacker-controlled ever
+reaches ``pickle.loads``, the secret never crosses the wire, and a
+captured handshake cannot be replayed (the MAC is bound to a dead
+nonce). Payload frames after the handshake are not otherwise
+integrity-protected: the threat model is job-membership gating inside
+a cluster network, not a hostile man-in-the-middle (use an encrypted
+overlay for that). The secret
 is the manager-injected DLROVER_TPU_RUNTIME_TOKEN env (the manager
 generates one per job, unified/backend.worker_envs), falling back to a
 0600 token file in the job runtime dir for same-host/standalone use —
@@ -59,8 +64,20 @@ from dlrover_tpu.common.log import logger
 _MAX_MSG = int(os.getenv("DLROVER_TPU_RUNTIME_MAX_MSG", str(256 << 20)))
 
 RUNTIME_TOKEN_ENV = "DLROVER_TPU_RUNTIME_TOKEN"
-_AUTH_MAGIC = b"DTRT1"
-_AUTH_LEN = len(_AUTH_MAGIC) + hashlib.sha256().digest_size
+_AUTH_MAGIC = b"DTRT2"
+_NONCE_LEN = 16
+_AUTH_CHALLENGE_LEN = len(_AUTH_MAGIC) + _NONCE_LEN
+_AUTH_REPLY_LEN = len(_AUTH_MAGIC) + hashlib.sha256().digest_size
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
 
 
 def _require_private(path: str, what: str):
@@ -356,23 +373,26 @@ class WorkerEndpoint:
             }
 
     def authenticate(self, sock: socket.socket) -> bool:
-        """Read the fixed-length preamble and verify the job secret —
-        BEFORE any pickle byte is parsed. False closes the connection."""
+        """Challenge-response handshake, BEFORE any pickle byte is
+        parsed: the server sends a fresh random nonce; the client
+        proves job membership with HMAC(sha256(token), nonce). A
+        passive observer of an earlier connection captures only a MAC
+        bound to a dead nonce — replaying it fails (advisor r4: the
+        previous static sha256(token) preamble was replayable). False
+        closes the connection."""
         try:
             sock.settimeout(10.0)
-            buf = b""
-            while len(buf) < _AUTH_LEN:
-                chunk = sock.recv(_AUTH_LEN - len(buf))
-                if not chunk:
-                    return False
-                buf += chunk
+            nonce = secrets.token_bytes(_NONCE_LEN)
+            sock.sendall(_AUTH_MAGIC + nonce)
+            buf = _recv_exact(sock, _AUTH_REPLY_LEN)
+            if buf is None:
+                return False
             sock.settimeout(None)
         except OSError:
             return False
-        magic, digest = buf[: len(_AUTH_MAGIC)], buf[len(_AUTH_MAGIC):]
-        if magic != _AUTH_MAGIC or not hmac.compare_digest(
-            digest, self._digest
-        ):
+        magic, mac = buf[: len(_AUTH_MAGIC)], buf[len(_AUTH_MAGIC):]
+        expect = hmac.new(self._digest, nonce, hashlib.sha256).digest()
+        if magic != _AUTH_MAGIC or not hmac.compare_digest(mac, expect):
             try:
                 peer = sock.getpeername()
             except OSError:
@@ -568,8 +588,19 @@ class _Conn:
         self._sock = socket.create_connection(
             (host, int(port)), timeout=timeout
         )
-        # Prove job membership before the first frame (see module doc).
-        self._sock.sendall(_AUTH_MAGIC + digest)
+        # Prove job membership before the first frame (see module doc):
+        # answer the server's nonce challenge with an HMAC keyed on the
+        # token digest — never the digest itself on the wire.
+        challenge = _recv_exact(self._sock, _AUTH_CHALLENGE_LEN)
+        if (
+            challenge is None
+            or challenge[: len(_AUTH_MAGIC)] != _AUTH_MAGIC
+        ):
+            self._sock.close()
+            raise RpcError(f"bad auth challenge from {addr}")
+        nonce = challenge[len(_AUTH_MAGIC):]
+        mac = hmac.new(digest, nonce, hashlib.sha256).digest()
+        self._sock.sendall(_AUTH_MAGIC + mac)
         self._lock = threading.Lock()
 
     def call(self, req: dict, timeout: Optional[float]) -> dict:

@@ -91,7 +91,12 @@ def test_16_device_dryrun_certifies():
         env=env, capture_output=True, text=True, timeout=900, cwd=repo,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "certified 4 meshes" in proc.stdout, proc.stdout
+    assert "certified 6 meshes" in proc.stdout, proc.stdout
+    # Round-5 additions: the forced-dropless ep mesh (ragged all-to-all
+    # path) and the forced fused CE executing its GSPMD vocab-scan
+    # impl multi-device must be among the certified set.
+    assert "moe_impl=dropless" in proc.stdout, proc.stdout
+    assert "ce=fused:xla" in proc.stdout, proc.stdout
     assert "Involuntary full rematerialization" not in proc.stderr, (
         [ln for ln in proc.stderr.splitlines() if "Involuntary" in ln][:2]
     )

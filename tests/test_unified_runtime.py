@@ -258,9 +258,66 @@ def test_raw_garbage_connection_never_reaches_dispatch(job_env):
         s = socket_mod.create_connection((host, int(port)), timeout=5.0)
         s.sendall(len(frame).to_bytes(8, "big") + frame)
         s.settimeout(2.0)
+        s.recv(64)  # the server's nonce challenge
         assert s.recv(1) == b"", "server replied to unauthenticated peer"
         s.close()
         assert not called
+    finally:
+        ep.close()
+
+
+def test_captured_handshake_replay_is_refused(job_env):
+    """Challenge-response: a passive observer replaying a previously
+    captured (valid) handshake reply must be dropped — the MAC is bound
+    to the dead connection's nonce (advisor r4)."""
+    import socket as socket_mod
+
+    ep = WorkerEndpoint()
+    try:
+        called = []
+        ep.export("probe", lambda: called.append(1) or "hit")
+        FileRegistry(job_env).register_worker("trainer", 0, ep.addr)
+
+        # Legitimate handshake, captured byte-for-byte.
+        good = RuntimeClient(job_env, resolve_timeout=5.0)
+        assert good.rpc("trainer", "probe") == "hit"
+        good.close()
+        from dlrover_tpu.unified import rpc as rpc_mod
+
+        host, port = ep.addr.rsplit(":", 1)
+        s = socket_mod.create_connection((host, int(port)), timeout=5.0)
+        s.settimeout(2.0)
+        challenge = s.recv(rpc_mod._AUTH_CHALLENGE_LEN)
+        nonce = challenge[len(rpc_mod._AUTH_MAGIC):]
+        digest = rpc_mod._token_digest(
+            rpc_mod.resolve_runtime_token(job_env)
+        )
+        import hashlib
+        import hmac as hmac_mod
+
+        valid_reply = rpc_mod._AUTH_MAGIC + hmac_mod.new(
+            digest, nonce, hashlib.sha256
+        ).digest()
+        s.sendall(valid_reply)
+        import pickle
+
+        frame = pickle.dumps({"kind": "rpc", "method": "probe"})
+        s.sendall(len(frame).to_bytes(8, "big") + frame)
+        n = int.from_bytes(s.recv(8), "big")
+        assert n  # the genuine handshake reached dispatch
+        s.close()
+
+        # Replay the SAME reply on a fresh connection: new nonce, so
+        # the captured MAC no longer verifies.
+        before = len(called)
+        s2 = socket_mod.create_connection((host, int(port)), timeout=5.0)
+        s2.settimeout(2.0)
+        s2.recv(rpc_mod._AUTH_CHALLENGE_LEN)
+        s2.sendall(valid_reply)
+        s2.sendall(len(frame).to_bytes(8, "big") + frame)
+        assert s2.recv(1) == b"", "replayed handshake was accepted"
+        s2.close()
+        assert len(called) == before  # replay never reached dispatch
     finally:
         ep.close()
 
