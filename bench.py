@@ -73,6 +73,29 @@ PEAK_FLOPS = {
     "TPU v6": 918e12,        # trillium
 }
 
+# Spec HBM bandwidth by device kind: the decode roofline's
+# denominator. The measured copy probe drifted 608-1042 GB/s across
+# runs of the same code on the same chip (tunnel-jittered overhead
+# subtraction), which made decode_vs_roofline incomparable
+# round-over-round; the spec number is stable and checkable. The
+# probe's value is still reported as decode_hbm_bw_gbs_measured.
+PEAK_HBM_BW = {
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5": 2765e9,        # v5p
+    "TPU v4": 1228e9,
+    "TPU v6": 1640e9,        # trillium
+}
+
+
+def device_peak_hbm_bw() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix in sorted(PEAK_HBM_BW, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return PEAK_HBM_BW[prefix]
+    return 819e9
+
 
 def device_peak_flops() -> float:
     import jax
@@ -782,7 +805,10 @@ def decode_phase():
     out = {
         "decode_prompt_len": prompt_len,
         "decode_new_tokens": new,
-        "decode_hbm_bw_gbs": round(probe_hbm_bandwidth_gbs(), 1),
+        "decode_hbm_bw_gbs": round(device_peak_hbm_bw() / 1e9, 1),
+        "decode_hbm_bw_gbs_measured": round(
+            probe_hbm_bandwidth_gbs(), 1
+        ),
     }
 
     def run_once(batch):
@@ -1306,6 +1332,11 @@ _KEEP_KEYS = {
     "longctx_mfu_pct", "longctx_remat",
     "moe_dropless_tokens_per_s", "moe_dropless_ep1_proxy_ms",
     "profiler_overhead_pct",
+    # Small headline ratios the README cites — the detailed per-size ms
+    # keys stay droppable, but these must survive pruning (the live
+    # round-5 run lost attn/ring speedups from every emitted line).
+    "attn_pallas_speedup_s4096", "ring_inner_speedup_s8192",
+    "ce_fused_chunked_ms", "longctx_step_ms", "longctx_tokens_per_s",
     "prev_round_diff",
 }
 
@@ -1470,15 +1501,35 @@ def main():
         run_phase(result, "decode", decode_phase, est_s=200)
         run_phase(result, "longctx", longctx_phase, est_s=220)
         run_phase(result, "moe", moe_phase, est_s=260)
-        run_phase(result, "attn_ab", attention_ab_phase, est_s=120)
-        run_phase(
-            result, "ring_inner_ab", ring_inner_ab_phase, est_s=140
-        )
+        # Profiler overhead BEFORE the A/B tail: it backs a README row
+        # (the live round-5 run spent its budget on the A/Bs and
+        # skipped it).
         run_phase(
             result, "profiler_overhead", profiler_overhead_phase,
             est_s=180,
         )
+        run_phase(result, "attn_ab", attention_ab_phase, est_s=120)
+        run_phase(
+            result, "ring_inner_ab", ring_inner_ab_phase, est_s=140
+        )
     emit(result)
+    # Persist the FULL (unpruned) result next to the driver artifacts:
+    # the driver's 2000-char tail capture truncates, and round 4 proved
+    # an empty artifact unrecoverable. README claims regenerate from
+    # the newest data-bearing artifact, this file included
+    # (tools/render_claims.py).
+    try:
+        with open(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_SELF.json",
+            ),
+            "w",
+        ) as f:
+            json.dump(result, f)
+            f.write("\n")
+    except OSError:
+        pass
     # Hard exit: nothing (jax atexit, stray threads) may print after the
     # final line — the driver parses the LAST line of the tail.
     sys.stdout.flush()

@@ -340,23 +340,7 @@ class ElasticAgent:
                         pass
             if dumped:
                 time.sleep(0.5)
-            for w in self._workers:
-                if w.process.poll() is not None:
-                    continue
-                try:
-                    from dlrover_tpu.tpu_timer.native_stack import (
-                        sample_native_stacks,
-                    )
-
-                    text = sample_native_stacks(w.process.pid)
-                except Exception:  # noqa: BLE001 - diagnosis best-effort
-                    text = None
-                if text and w.log_file:
-                    try:
-                        w.log_file.write(text.encode())
-                        w.log_file.flush()
-                    except (OSError, ValueError):
-                        pass
+            self._capture_native_stacks()
         for w in self._workers:
             if w.process.poll() is None:
                 try:
@@ -378,6 +362,45 @@ class ElasticAgent:
             if w.log_file:
                 w.log_file.close()
                 w.log_file = None
+
+    def _capture_native_stacks(self, timeout: float = 12.0):
+        """Append native (ptrace+libunwind) stacks of every live worker
+        to its log, CONCURRENTLY and with a hard bound — this runs on
+        the hang-recovery path, where the diagnostic must never become
+        the delay (advisor r5: first-use sampler builds and serial
+        20s/worker sampling could add minutes before SIGTERM; the
+        sampler binary is prebuilt at agent start)."""
+        try:
+            from dlrover_tpu.tpu_timer.native_stack import (
+                sample_native_stacks,
+            )
+        except Exception:  # noqa: BLE001 - diagnosis best-effort
+            return
+
+        def one(w):
+            try:
+                text = sample_native_stacks(
+                    w.process.pid, timeout=timeout
+                )
+            except Exception:  # noqa: BLE001
+                text = None
+            if text and w.log_file:
+                try:
+                    w.log_file.write(text.encode())
+                    w.log_file.flush()
+                except (OSError, ValueError):
+                    pass
+
+        threads = [
+            threading.Thread(target=one, args=(w,), daemon=True)
+            for w in self._workers
+            if w.process.poll() is None
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + timeout + 3.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.time(), 0.1))
 
     def _restart_workers(self, post_mortem: bool = False):
         restart_start = time.time()
@@ -526,6 +549,20 @@ class ElasticAgent:
 
     def run(self) -> RunResult:
         self._diagnosis_agent.start()
+        # Prebuild the native stack sampler off the critical path: a
+        # first-use g++ build during hang recovery would delay the
+        # restart (see _capture_native_stacks).
+        def _prebuild():
+            try:
+                from dlrover_tpu.tpu_timer.native_stack import (
+                    ensure_built,
+                )
+
+                ensure_built()
+            except Exception:  # noqa: BLE001 - diagnosis best-effort
+                pass
+
+        threading.Thread(target=_prebuild, daemon=True).start()
         try:
             return self._run()
         except RendezvousEvictedError:

@@ -71,8 +71,8 @@ def _decode_attn_impl() -> str:
     (ops/decode_attention.py) reads only the filled cache blocks, but
     its (batch, kv_head, block) grid runs SEQUENTIALLY on TPU — at the
     flagship decode shape the serialization costs more than the padded
-    reads it saves (measured v5e b=8: 3.77 vs 2.24 ms/token; the bench
-    A/B keeps both on record). DLROVER_TPU_DECODE_ATTN=pallas opts in
+    reads it saves (measured v5e b=8: 3.58 vs 1.26 ms/token against
+    the append-free XLA step; the bench A/B keeps both on record). DLROVER_TPU_DECODE_ATTN=pallas opts in
     (wins would need batch*kv_heads small or caches much longer than
     the fill)."""
     import os
@@ -170,14 +170,14 @@ def _layer_decode(
     else:
         # Plain attention over the full pre-allocated cache; with
         # contiguous query positions the causal mask already excludes
-        # every unfilled slot. Two length-aware alternatives were
-        # measured and REJECTED on v5e (b=8, 334M): the Pallas kernel
-        # above (sequential grid, 3.8 vs 2.3 ms/token — opt-in only)
-        # and lax.switch-bucketed static prefixes (no gain at b>=8, and
-        # the per-layer branch dispatch cost b=1 0.92 -> 1.39 ms/token)
-        # — the padded reads are NOT the decode bottleneck; per-step
-        # dispatch overhead of the ~160-op layer graph is (see
-        # decode_vs_roofline in the bench).
+        # every unfilled slot. This path now serves PREFILL (sq > 1)
+        # and the opt-in Pallas A/B only — the single-token hot loop
+        # uses the append-free step (_layer_decode_read_only), which
+        # removed the per-token cache rebuild that dominated this
+        # path's profile. Other rejected alternatives (v5e, b=8,
+        # 334M): the sequential-grid Pallas kernel (3.6 vs 1.3
+        # ms/token) and lax.switch-bucketed static prefixes (no gain
+        # at b>=8, b=1 0.92 -> 1.39 ms/token).
         attn = dot_product_attention(
             q,
             k_cache,
@@ -194,8 +194,96 @@ def _layer_decode(
     return x, k_cache, v_cache
 
 
+def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
+    """Single-token attention WITHOUT materializing an updated cache.
+
+    The padded-cache decode path spent 21% of device time on two
+    100-200MB per-token copies (measured v5e op profile): the layer
+    scan rebuilt the full [L, b, max_len, kh, d] cache as stacked scan
+    outputs every token, and XLA inserted a layout copy feeding it back
+    to the next step. Here the cache is a READ-ONLY input; the new
+    token's attention is decomposed into a cache part and a
+    new-token part with a merged softmax (exact same math as
+    dot_product_attention over the DUS'd cache — the new token is
+    always its own last visible key), and the caller appends all
+    layers' new K/V with ONE small dynamic-update-slice per token.
+
+    q: [b, 1, h, d]; k_cache/v_cache: [b, S, kh, d] (slots >=
+    cache_len unfilled); k_new/v_new: [b, 1, kh, d]. Returns
+    [b, 1, h, d].
+    """
+    from dlrover_tpu.ops.attention import NEG_INF
+
+    b, _, h, d = q.shape
+    _, skv, kh, _ = k_cache.shape
+    g = h // kh
+    scale = d ** -0.5
+    q32 = (q[:, 0] * scale).astype(jnp.float32).reshape(b, kh, g, d)
+    # Cache part: [b, kh, g, S]; only filled slots are visible.
+    logits = jnp.einsum(
+        "bkgd,bskd->bkgs", q32, k_cache.astype(jnp.float32)
+    )
+    visible = jnp.arange(skv) < cache_len  # [S]
+    logits = jnp.where(visible[None, None, None, :], logits, NEG_INF)
+    # New-token part: the query always sees itself.
+    l_new = jnp.einsum(
+        "bkgd,bkd->bkg", q32, k_new[:, 0].astype(jnp.float32)
+    )
+    m = jnp.maximum(jnp.max(logits, axis=-1), l_new)  # [b, kh, g]
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where(visible[None, None, None, :], p, 0.0)
+    p_new = jnp.exp(l_new - m)
+    denom = jnp.sum(p, axis=-1) + p_new  # >= p_new > 0
+    out = (
+        jnp.einsum("bkgs,bskd->bkgd", p, v_cache.astype(jnp.float32))
+        + p_new[..., None] * v_new[:, 0].astype(jnp.float32)[:, :, None]
+    ) / denom[..., None]
+    return out.reshape(b, 1, h, d).astype(q.dtype)
+
+
+def _layer_decode_read_only(
+    config, p, x, positions, k_cache, v_cache, cache_len
+):
+    """One decoder block over [b, 1] tokens; the cache is read-only.
+    Returns (x, k_new [b, 1, kh, d], v_new) — the caller batches the
+    cache append across all layers (see _append_free_attention)."""
+    residual = x
+    if "wqkv" in p:
+        q, k, v = _fused_qkv(config, p, x, positions)
+    else:
+        q, k, v = llama.attention_qkv(config, p, x, positions)
+    attn = _append_free_attention(q, k_cache, v_cache, k, v, cache_len)
+    x = llama.attention_out(config, p, attn, residual)
+    if "w_gu" in p:
+        x = _fused_mlp(config, p, x)
+    else:
+        x, _ = llama.mlp_block(config, p, x)
+    return x, k, v
+
+
+def _layer_scan_unroll(n_layers: int) -> int:
+    """Unroll factor for the decode-time layer scan. ROLLED is the
+    measured winner: with the append-free step the rolled scan lets
+    XLA alias the cache append in place (measured v5e, 334M, b=8:
+    1.38 ms/token, zero per-token cache copies in the op profile),
+    while unrolling reintroduces 100-200MB/token of cache copy
+    traffic (1.47-1.74 ms/token) — the unrolled straight-line code
+    defeats the buffer aliasing that the loop structure makes
+    provable. DLROVER_TPU_DECODE_UNROLL overrides for experiments."""
+    import os
+
+    raw = os.environ.get("DLROVER_TPU_DECODE_UNROLL", "")
+    if raw:
+        try:
+            return max(1, min(int(raw), n_layers))
+        except ValueError:
+            pass
+    return 1
+
+
 def _forward_with_cache(
-    config, params, tokens, cache: DecodeCache, attn_impl=None
+    config, params, tokens, cache: DecodeCache, attn_impl=None,
+    unroll=None,
 ):
     """Run [b, sq] tokens through all layers, appending to the cache.
     Returns (logits of the LAST position [b, vocab], new cache)."""
@@ -204,18 +292,47 @@ def _forward_with_cache(
         jnp.arange(sq, dtype=jnp.int32), (b, sq)
     )
     x = llama.embed_tokens(config, params, tokens)
+    unroll = unroll or _layer_scan_unroll(config.n_layers)
 
-    def body(carry, layer_in):
-        pl, k_c, v_c = layer_in
-        y, k_c, v_c = _layer_decode(
-            config, pl, carry, positions, k_c, v_c, cache.length,
-            attn_impl=attn_impl,
+    if sq == 1 and (attn_impl or _decode_attn_impl()) != "pallas":
+        # Append-free single-token step (the decode hot loop): the
+        # layer scan READS the cache; each layer returns only its new
+        # token's K/V, and one small dynamic-update-slice appends all
+        # layers at once. The padded-cache path below rebuilt the full
+        # cache as stacked scan outputs — 100-200MB of per-token copy
+        # traffic, 21% of decode device time (v5e op profile).
+        def body1(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_new, v_new = _layer_decode_read_only(
+                config, pl, carry, positions, k_c, v_c, cache.length
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body1, x, (params["layers"], cache.k, cache.v),
+            unroll=unroll,
         )
-        return y, (k_c, v_c)
+        new_k = jax.lax.dynamic_update_slice(
+            cache.k, k_news.astype(cache.k.dtype),
+            (0, 0, cache.length, 0, 0),
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            cache.v, v_news.astype(cache.v.dtype),
+            (0, 0, cache.length, 0, 0),
+        )
+    else:
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_c, v_c = _layer_decode(
+                config, pl, carry, positions, k_c, v_c, cache.length,
+                attn_impl=attn_impl,
+            )
+            return y, (k_c, v_c)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
-    )
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v),
+            unroll=unroll,
+        )
     logits = llama.unembed(config, params, x[:, -1:, :])[:, 0, :]
     new_cache = DecodeCache(k=new_k, v=new_v, length=cache.length + sq)
     return logits, new_cache
@@ -234,13 +351,15 @@ def _compiled_generate(
     max_len: int,
     temperature: float,
     attn_impl: str = "xla",
+    unroll: int = 0,
 ):
     """One compiled program per (config, shapes, temperature,
-    attn_impl) — repeat generate() calls reuse it (jit caches key on
-    the function object, which must therefore be cached itself). The
-    decode-attention impl is an EXPLICIT cache-key argument: generate()
-    resolves the DLROVER_TPU_DECODE_ATTN env var per call, so toggling
-    it takes effect without cache_clear() (advisor r4)."""
+    attn_impl, unroll) — repeat generate() calls reuse it (jit caches
+    key on the function object, which must therefore be cached
+    itself). The decode-attention impl and the layer-scan unroll are
+    EXPLICIT cache-key arguments: generate() resolves their env knobs
+    per call, so toggling them takes effect without cache_clear()
+    (advisor r4)."""
 
     def pick(logits, rng):
         if temperature <= 0.0:
@@ -274,7 +393,8 @@ def _compiled_generate(
         }
         cache = init_cache(config, batch, max_len)
         logits, cache = _forward_with_cache(
-            config, params, prompt, cache, attn_impl=attn_impl
+            config, params, prompt, cache, attn_impl=attn_impl,
+            unroll=unroll or None,
         )
         rng, first_key = jax.random.split(rng)
         first = pick(logits, first_key)
@@ -283,7 +403,8 @@ def _compiled_generate(
             cache, tok, rng = carry
             rng, sub = jax.random.split(rng)
             logits, cache = _forward_with_cache(
-                config, params, tok[:, None], cache, attn_impl=attn_impl
+                config, params, tok[:, None], cache,
+                attn_impl=attn_impl, unroll=unroll or None,
             )
             nxt = pick(logits, sub)
             return (cache, nxt, rng), tok
@@ -324,6 +445,7 @@ def generate(
     run = _compiled_generate(
         config, b, max_new_tokens, max_len, float(temperature),
         attn_impl=_decode_attn_impl(),
+        unroll=_layer_scan_unroll(config.n_layers),
     )
     tokens, cache = run(params, prompt, rng)
     return GenerateResult(tokens=tokens, cache=cache)

@@ -152,6 +152,15 @@ def _flash_forward(q, k, v, causal, softmax_scale, interpret):
     # - otherwise (d=64 etc.): transpose to [b, h, s, d] so the minor
     #   block dim equals the full array d — costs one HBM copy per
     #   operand, still far cheaper than materialized s^2 logits.
+    # NOTE: clamping kv/q block indices to the causal diagonal (so
+    # compute-skipped future tiles revisit the resident block instead
+    # of streaming one they never read, Mosaic eliding the copy on an
+    # unchanged index) was swept on v5e at s in {8k, 32k} across all
+    # three kernels and REJECTED: every apparent win (best 42 -> 37.5
+    # ms fwd+bwd at 32k in one session) failed to reproduce across
+    # fresh sessions — the deltas sat inside the ±8% session-to-session
+    # spread, while the non-affine index maps measurably slowed the
+    # forward (18.1 -> 19.1 ms). Simple affine maps win.
     if d % 128 == 0 or h == 1:
         operands = (
             q.reshape(b, sq, h * d),

@@ -87,14 +87,11 @@ def parse_chrome_trace(path: str) -> List[Tuple[str, bool, float, float]]:
     return out
 
 
-def capture_device_events(
-    capture_s: float = 1.0, keep_host_runtime: bool = True
-) -> List[Tuple[str, bool, float, float]]:
-    """Capture a trace window and return its runtime/device events.
-
-    The profiler samples whatever the process is executing on device
-    during the window — this thread only opens/closes the session.
-    """
+def _capture_trace(parse_fn, capture_s: float):
+    """Open a trace session for ``capture_s``, close it ON ANY EXIT (a
+    leaked active session breaks every later capture in the process),
+    and apply ``parse_fn`` to the newest trace file. The single home of
+    the session/teardown invariant for both capture entry points."""
     import jax
 
     tmpdir = tempfile.mkdtemp(prefix="dlrover_tpu_xla_cap_")
@@ -103,8 +100,6 @@ def capture_device_events(
         try:
             time.sleep(capture_s)
         finally:
-            # Close on any exit: a leaked active session breaks every
-            # later capture in the process.
             jax.profiler.stop_trace()
         traces = sorted(
             glob.glob(
@@ -115,7 +110,22 @@ def capture_device_events(
         )
         if not traces:
             return []
-        events = parse_chrome_trace(traces[-1])
+        return parse_fn(traces[-1])
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def capture_device_events(
+    capture_s: float = 1.0, keep_host_runtime: bool = True
+) -> List[Tuple[str, bool, float, float]]:
+    """Capture a trace window and return its runtime/device events.
+
+    The profiler samples whatever the process is executing on device
+    during the window — this thread only opens/closes the session.
+    """
+
+    def parse(path):
+        events = parse_chrome_trace(path)
         if keep_host_runtime:
             return [
                 ev
@@ -123,8 +133,8 @@ def capture_device_events(
                 if ev[1] or _RUNTIME_NAME_RE.search(ev[0])
             ]
         return [ev for ev in events if ev[1]]
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    return _capture_trace(parse, capture_s)
 
 
 def parse_op_profile(path: str) -> List[Dict]:
@@ -176,30 +186,7 @@ def parse_op_profile(path: str) -> List[Dict]:
 def capture_op_profile(capture_s: float = 1.0) -> List[Dict]:
     """Capture a trace window and return the per-op profile
     (parse_op_profile rows) of whatever ran on device during it."""
-    import jax
-
-    tmpdir = tempfile.mkdtemp(prefix="dlrover_tpu_xla_prof_")
-    try:
-        jax.profiler.start_trace(tmpdir)
-        try:
-            time.sleep(capture_s)
-        finally:
-            # The session MUST close on any exit — a leaked active
-            # trace makes every later capture in the process raise
-            # "profiler already active".
-            jax.profiler.stop_trace()
-        traces = sorted(
-            glob.glob(
-                os.path.join(
-                    tmpdir, "plugins", "profile", "*", "*.trace.json.gz"
-                )
-            )
-        )
-        if not traces:
-            return []
-        return parse_op_profile(traces[-1])
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
+    return _capture_trace(parse_op_profile, capture_s)
 
 
 def bucket_by_scope(

@@ -592,10 +592,16 @@ class _Conn:
         # answer the server's nonce challenge with an HMAC keyed on the
         # token digest — never the digest itself on the wire.
         challenge = _recv_exact(self._sock, _AUTH_CHALLENGE_LEN)
-        if (
-            challenge is None
-            or challenge[: len(_AUTH_MAGIC)] != _AUTH_MAGIC
-        ):
+        if challenge is None:
+            # Peer closed before sending the nonce — a worker dying or
+            # mid-restart behind a stale registry address. Nothing was
+            # sent yet, so this is safely retryable: raise the
+            # ConnectionError the callers' dead-peer retry loops catch
+            # (an RpcError here would turn a gang restart into a hard
+            # failure).
+            self._sock.close()
+            raise ConnectionError(f"peer {addr} closed during handshake")
+        if challenge[: len(_AUTH_MAGIC)] != _AUTH_MAGIC:
             self._sock.close()
             raise RpcError(f"bad auth challenge from {addr}")
         nonce = challenge[len(_AUTH_MAGIC):]

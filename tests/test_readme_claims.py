@@ -28,7 +28,7 @@ def test_claims_block_matches_cited_artifact():
     import render_claims
 
     block = _block()
-    m = re.search(r"source: `(BENCH_r\d+\.json)`", block)
+    m = re.search(r"source: `(BENCH_(?:r\d+|SELF)\.json)`", block)
     assert m, (
         "claims block is unrendered — run python tools/render_claims.py"
     )

@@ -27,15 +27,42 @@ BEGIN, END = "<!-- claims:begin -->", "<!-- claims:end -->"
 
 
 def newest_artifact() -> str:
+    """Newest artifact THAT HAS DATA.
+
+    Driver artifacts (BENCH_r*.json) in numeric round order, newest
+    first — but an empty capture (round 4's rc=124 artifact holds no
+    keys) must not freeze the claims at an older round, so artifacts
+    without a single extractable headline key are skipped. A
+    bench-written BENCH_SELF.json (the full in-round measurement the
+    driver's 2000-char tail would truncate) outranks driver artifacts
+    when it is fresher than the newest of them."""
     files = glob.glob(os.path.join(REPO, "BENCH_r*.json"))
-    if not files:
-        raise SystemExit("no BENCH_r*.json artifact found")
+
     # Numeric round order: lexicographic would put r10 before r9.
     def round_no(p):
         m = re.search(r"BENCH_r(\d+)\.json$", p)
         return int(m.group(1)) if m else -1
 
-    return max(files, key=round_no)
+    def has_data(p):
+        try:
+            text = open(p).read()
+        except OSError:
+            return False
+        return extract(text, "mfu_pct") is not None or extract(
+            text, "measured_recovery_s"
+        ) is not None or extract(text, "value") is not None
+
+    ordered = sorted(files, key=round_no, reverse=True)
+    newest_driver = next((p for p in ordered if has_data(p)), None)
+    self_path = os.path.join(REPO, "BENCH_SELF.json")
+    if os.path.exists(self_path) and has_data(self_path):
+        if newest_driver is None or os.path.getmtime(
+            self_path
+        ) >= os.path.getmtime(newest_driver):
+            return self_path
+    if newest_driver is None:
+        raise SystemExit("no artifact with data found")
+    return newest_driver
 
 
 def extract(text: str, key: str):
@@ -96,13 +123,21 @@ def render_block(path: str) -> str:
         ("Decode (batch 8, 334M)",
          g("decode_ms_per_token"),
          f"{fmt(g('decode_ms_per_token'), 2)} ms/token"),
+        ("Decode vs HBM roofline (spec BW; params+filled KV floor)",
+         g("decode_vs_roofline"),
+         f"{fmt(g('decode_vs_roofline'), 2)}x"),
         ("Profiler capture overhead (60s cadence)",
          g("profiler_overhead_pct"),
          f"{fmt(g('profiler_overhead_pct'), 3)}%"),
     ]
+    origin = (
+        "full in-round measurement written by bench.py"
+        if name == "BENCH_SELF.json"
+        else "driver-captured"
+    )
     lines = [
         f"Measured on real v5e hardware — source: `{name}` "
-        "(driver-captured).",
+        f"({origin}).",
         "",
         "| Metric | Measured |",
         "|---|---|",
