@@ -32,18 +32,49 @@ class TrainingHangDiagnostician(Diagnostician):
         job_manager=None,
         hang_timeout_s: float = 600.0,
         restart_after_s: float = 1800.0,
+        metric_context=None,
     ):
         self._perf_monitor = perf_monitor
         self._job_manager = job_manager
         self._hang_timeout_s = hang_timeout_s
         self._restart_after_s = restart_after_s
         self._hang_since = 0.0
+        # Optional out-of-band corroboration (common/metric.py): the
+        # native daemons' step counters come from a C++ thread, so a
+        # worker wedged inside libtpu still reports — a frozen counter
+        # there is independent evidence the in-band RPC path can't give
+        # (and an advancing one vetoes a false hang from lost reports).
+        self._metric_context = metric_context
 
     def observe(self, **kwargs) -> Observation:
         started = self._perf_monitor.global_step > 0
         stagnated = started and self._perf_monitor.step_stagnated(
             self._hang_timeout_s
         )
+        if started and self._metric_context is not None:
+            from dlrover_tpu.common.metric import STEP_COUNTER
+
+            def advancing(node):
+                window = self._metric_context.window(
+                    node, STEP_COUNTER, self._hang_timeout_s
+                )
+                values = [v for _, v in window]
+                return len(values) >= 2 and max(values) > min(values)
+
+            oob_frozen = self._metric_context.steps_frozen(
+                self._hang_timeout_s
+            )
+            if stagnated and not oob_frozen and any(
+                advancing(n) for n in self._metric_context.nodes()
+            ):
+                # In-band reports stalled but a native counter is
+                # demonstrably ADVANCING: the reporting path is the
+                # problem, not the training. (Mere sample existence is
+                # not evidence — daemons that answered once then died
+                # must not veto a real hang.)
+                stagnated = False
+            elif not stagnated and oob_frozen:
+                stagnated = True
         nodes_alive = True
         if self._job_manager is not None and hasattr(
             self._job_manager, "all_running_node_hanged"

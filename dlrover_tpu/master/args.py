@@ -41,6 +41,13 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="host:port of a brain service (cross-job stats + optimizer)",
     )
     parser.add_argument(
+        "--metric_endpoints",
+        type=str,
+        default="",
+        help="out-of-band metric scrape targets, 'node=host:port,...' "
+        "(per-node tpu_timer daemons or any Prometheus exporter)",
+    )
+    parser.add_argument(
         "--topology_aware",
         action="store_true",
         default=False,

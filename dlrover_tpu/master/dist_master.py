@@ -35,6 +35,30 @@ from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.rpc.transport import create_master_server
 
 
+def _parse_metric_endpoints(raw: str):
+    """"0=host:port,1=host:port" -> {0: "host:port", ...} (CLI form of
+    the metric monitor's endpoint map; programmatic callers pass a dict
+    or a callable instead). Malformed input fails with a message that
+    names the flag, not a bare traceback during master startup."""
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            node, addr = part.split("=", 1)
+            out[int(node)] = addr
+        except ValueError:
+            raise SystemExit(
+                f"--metric_endpoints: bad entry {part!r} "
+                "(expected 'node_id=host:port,...')"
+            )
+    return out or None
+
+
+
 class DistributedJobMaster:
     def __init__(
         self,
@@ -59,6 +83,7 @@ class DistributedJobMaster:
         brain_addr: str = "",
         topology_aware: bool = False,
         node_group_size: int = 0,
+        metric_endpoints=None,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -87,6 +112,16 @@ class DistributedJobMaster:
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
         )
+        # Out-of-band cluster metric monitor (common/metric.py): scrape
+        # the per-node tpu_timer daemons (or any Prometheus exporter)
+        # into a windowed context the hang diagnostician corroborates
+        # against. ``metric_endpoints``: {node_id: "host:port"} or a
+        # zero-arg callable re-resolving them (elastic clusters).
+        self.metric_monitor = None
+        if metric_endpoints:
+            from dlrover_tpu.common.metric import JobMetricMonitor
+
+            self.metric_monitor = JobMetricMonitor(metric_endpoints)
         if diagnosis_master is None and with_diagnosis:
             diagnosis_master = self._build_diagnosis_master(pre_check)
         self.diagnosis_master = diagnosis_master
@@ -195,7 +230,15 @@ class DistributedJobMaster:
 
         manager = DiagnosisManager()
         manager.register(
-            TrainingHangDiagnostician(self.perf_monitor, self.job_manager)
+            TrainingHangDiagnostician(
+                self.perf_monitor,
+                self.job_manager,
+                metric_context=(
+                    self.metric_monitor.context
+                    if self.metric_monitor is not None
+                    else None
+                ),
+            )
         )
         manager.register(NodeFailureDiagnostician())
         manager.register(NodeInconsistencyDiagnostician())
@@ -259,6 +302,9 @@ class DistributedJobMaster:
             global_batch_size=getattr(args, "global_batch_size", 0),
             devices_per_node=getattr(args, "devices_per_node", 4),
             brain_addr=getattr(args, "brain_addr", ""),
+            metric_endpoints=_parse_metric_endpoints(
+                getattr(args, "metric_endpoints", "")
+            ),
             node_group_size=getattr(args, "node_unit", 0),
             topology_aware=getattr(args, "topology_aware", False),
         )
@@ -283,6 +329,8 @@ class DistributedJobMaster:
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()
+        if self.metric_monitor is not None:
+            self.metric_monitor.start()
         if self.dashboard is not None:
             self.dashboard.start()
         if self.auto_scaler is not None:
@@ -357,6 +405,8 @@ class DistributedJobMaster:
             failure_count=self._job_context.failure_count,
         )
         self.metric_collector.stop()
+        if self.metric_monitor is not None:
+            self.metric_monitor.stop()
         if self.dashboard is not None:
             self.dashboard.stop()
         if self.auto_scaler is not None:

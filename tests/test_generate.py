@@ -132,3 +132,75 @@ def test_decode_attn_pallas_matches_xla(monkeypatch):
     _compiled_generate.cache_clear()
 
     assert (got.tokens == ref.tokens).all(), (got.tokens, ref.tokens)
+
+
+def test_append_free_attention_matches_padded_cache_path():
+    """The decode hot loop's merged-softmax decomposition must equal
+    dot_product_attention over the DUS'd padded cache exactly (same
+    f32 softmax, GQA grouping, masking) — the two paths serve the same
+    step and may never drift."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.generate import _append_free_attention
+    from dlrover_tpu.ops.attention import dot_product_attention
+
+    b, S, h, kh, d = 3, 64, 8, 4, 32
+    cache_len = 41
+    kq, kk, kv, kn, kw = jax.random.split(jax.random.key(0), 5)
+    q = jax.random.normal(kq, (b, 1, h, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (b, S, kh, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (b, S, kh, d), jnp.float32)
+    # Slots >= cache_len are garbage the math must never read.
+    garbage = 1e3 * jax.random.normal(kn, (b, S - cache_len, kh, d))
+    k_cache = k_cache.at[:, cache_len:].set(garbage)
+    k_new = jax.random.normal(kw, (b, 1, kh, d), jnp.float32)
+    v_new = jax.random.normal(jax.random.key(9), (b, 1, kh, d))
+
+    got = _append_free_attention(
+        q, k_cache, v_cache, k_new, v_new, jnp.int32(cache_len)
+    )
+
+    # Reference: append the new token at the cursor and run the padded
+    # path with position masking (the pre-round-5 decode step).
+    k_full = jax.lax.dynamic_update_slice(
+        k_cache, k_new, (0, cache_len, 0, 0)
+    )
+    v_full = jax.lax.dynamic_update_slice(
+        v_cache, v_new, (0, cache_len, 0, 0)
+    )
+    ref = dot_product_attention(
+        q, k_full, v_full, causal=True,
+        q_positions=jnp.full((1,), cache_len),
+        kv_positions=jnp.arange(S),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_append_free_attention_empty_cache():
+    """First decoded token after an empty prefill window: only the new
+    token is visible; the result is exactly v_new broadcast to heads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.generate import _append_free_attention
+
+    b, S, h, kh, d = 2, 16, 4, 2, 8
+    q = jax.random.normal(jax.random.key(1), (b, 1, h, d), jnp.float32)
+    k_cache = jnp.zeros((b, S, kh, d), jnp.float32)
+    v_cache = jnp.zeros((b, S, kh, d), jnp.float32)
+    k_new = jax.random.normal(jax.random.key(2), (b, 1, kh, d))
+    v_new = jax.random.normal(jax.random.key(3), (b, 1, kh, d))
+    got = _append_free_attention(
+        q, k_cache, v_cache, k_new, v_new, jnp.int32(0)
+    )
+    # Softmax over a single visible key is 1.0 -> output == v_new per
+    # kv group.
+    expect = jnp.repeat(v_new, h // kh, axis=2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=1e-6, atol=1e-6
+    )

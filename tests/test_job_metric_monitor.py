@@ -1,0 +1,109 @@
+"""Master-side cluster metric monitor (common/metric.py).
+
+Parity: reference dlrover/python/common/metric/monitor.py:43-503 — an
+external-API scrape loop feeding a windowed per-node metric context
+that hang diagnosis consults. Here the external API is the native
+tpu_timer daemon's Prometheus endpoint, so the first test scrapes a
+REAL daemon.
+"""
+
+import time
+
+from dlrover_tpu.common.metric import (
+    STEP_COUNTER,
+    JobMetricContext,
+    JobMetricMonitor,
+)
+
+
+def test_scrapes_real_tpu_timer_daemon():
+    from dlrover_tpu.tpu_timer import get_timer
+
+    timer = get_timer()
+    if not getattr(timer, "port", 0):
+        timer.start_server(0)
+    timer.counter_add("steps", 7)
+    timer.set_gauge("goodput", 92.5)
+    monitor = JobMetricMonitor({0: f"127.0.0.1:{timer.port}"})
+    assert monitor.scrape_once() == 1
+    ctx = monitor.context
+    assert ctx.latest(0, "tpu_timer_gauge/goodput") == 92.5
+    assert ctx.latest(0, STEP_COUNTER) >= 7
+    assert 0 in ctx.summary()
+
+
+def test_unreachable_nodes_are_counted_not_fatal():
+    monitor = JobMetricMonitor({3: "127.0.0.1:1"})  # nothing listens
+    assert monitor.scrape_once() == 0
+    assert monitor.context.unreachable_count(3) == 1
+    assert monitor.context.latest(3, STEP_COUNTER) is None
+    assert monitor.context.summary()[3]["unreachable_scrapes"] == 1
+
+
+def _feed(ctx, node, steps, t0):
+    for i, s in enumerate(steps):
+        ctx.record(node, {STEP_COUNTER: float(s)}, ts=t0 + i)
+
+
+def test_steps_frozen_is_global_and_windowed():
+    ctx = JobMetricContext()
+    now = time.time()
+    # Node 0 frozen, node 1 advancing -> NOT a global hang (straggler
+    # attribution, not job restart).
+    _feed(ctx, 0, [10, 10, 10], now - 3)
+    _feed(ctx, 1, [10, 11, 12], now - 3)
+    assert not ctx.steps_frozen(span_s=60)
+    # Both frozen -> hang corroborated.
+    ctx2 = JobMetricContext()
+    _feed(ctx2, 0, [10, 10, 10], now - 3)
+    _feed(ctx2, 1, [12, 12, 12], now - 3)
+    assert ctx2.steps_frozen(span_s=60)
+    # Old samples outside the window don't count; a single in-window
+    # sample is not evidence either way.
+    ctx3 = JobMetricContext()
+    _feed(ctx3, 0, [10, 10], now - 600)
+    assert not ctx3.steps_frozen(span_s=60)
+
+
+def test_elastic_endpoint_resolution_and_injected_fetch():
+    calls = []
+
+    def endpoints():
+        return {0: "a:1", 1: "b:2"} if not calls else {0: "a:1"}
+
+    def fetch(addr, timeout):
+        calls.append(addr)
+        return 'tpu_timer_counter{name="steps"} 5\n'
+
+    monitor = JobMetricMonitor(endpoints, fetch=fetch)
+    assert monitor.scrape_once() == 2
+    assert monitor.scrape_once() == 1  # membership shrank
+    assert monitor.context.latest(1, STEP_COUNTER) == 5.0
+
+
+def test_hang_diagnostician_uses_out_of_band_counters():
+    """A frozen in-band PerfMonitor is VETOED by advancing native
+    counters (reporting-path failure, not a hang); frozen native
+    counters corroborate."""
+    from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+        TrainingHangDiagnostician,
+    )
+    from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+
+    now = time.time()
+    perf = PerfMonitor()
+    perf.collect_global_step(100, now - 500)  # stale -> stagnated
+
+    ctx = JobMetricContext()
+    _feed(ctx, 0, [100, 105, 110], now - 3)  # native side advancing
+    d = TrainingHangDiagnostician(
+        perf, hang_timeout_s=60.0, metric_context=ctx
+    )
+    assert d.observe().observation == ""  # vetoed
+
+    ctx_frozen = JobMetricContext()
+    _feed(ctx_frozen, 0, [110, 110, 110], now - 3)
+    d2 = TrainingHangDiagnostician(
+        perf, hang_timeout_s=60.0, metric_context=ctx_frozen
+    )
+    assert d2.observe().observation != ""  # corroborated hang

@@ -102,8 +102,13 @@ def render_block(path: str) -> str:
         ("Ring-attention inner block vs einsum (s=8192)",
          g("ring_inner_speedup_s8192"),
          f"{fmt(g('ring_inner_speedup_s8192'))}x"),
-        ("Fused chunked CE vs dense (time ratio; saves "
-         f"{fmt(g('ce_fused_logits_bytes_saved_mb'), 0)} MB logits)",
+        ("Fused chunked CE vs dense (time ratio"
+         + (
+             f"; saves {fmt(g('ce_fused_logits_bytes_saved_mb'), 0)}"
+             " MB logits"
+             if g("ce_fused_logits_bytes_saved_mb") is not None
+             else ""
+         ) + ")",
          g("ce_fused_chunked_vs_dense"),
          f"{fmt(g('ce_fused_chunked_vs_dense'), 3)}x"),
         ("Checkpoint save pause (async snapshot block)",
