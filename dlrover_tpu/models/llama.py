@@ -455,6 +455,75 @@ def unembed(config, params, x):
         )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _attn_block_lite(config, p, x, positions):
+    """Norm + qkv projection + rope + flash attention as ONE
+    differentiable unit whose backward residuals are (p, x, out,
+    compact lse) — NOT (q, k, v, out, lse).
+
+    This is what lets the ``attn_save`` remat policy fit at 64k
+    tokens: the plain escape pins q/k/v/out per layer (512MB/layer at
+    64k, 8GB across 16 layers — a compile-time HBM OOM on 16GB v5e),
+    while this block re-derives q/k/v from the saved layer input in
+    the backward (cheap projections, the same recompute the flanks
+    already pay) and still never re-runs the flash FORWARD (out/lse
+    are saved — re-running it is what makes plain "full" remat slow
+    at long context). ~258MB/layer saved at 64k."""
+    from dlrover_tpu.ops.pallas_attention import flash_attention
+
+    q, k, v = attention_qkv(config, p, x, positions)
+    return flash_attention(q, k, v, True)
+
+
+def _attn_block_lite_fwd(config, p, x, positions):
+    from dlrover_tpu.ops.pallas_attention import _flash_forward
+
+    q, k, v = attention_qkv(config, p, x, positions)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, True, None, interpret)
+    # lse compact [b*h, sq]: the lane-broadcast layout would pin 128x
+    # the bytes (same trade as pallas_attention._fwd).
+    return out, (p, x, positions, out, lse[:, :, 0])
+
+
+def _attn_block_lite_bwd(config, res, g):
+    import numpy as np
+
+    from dlrover_tpu.ops.pallas_attention import LANES, _flash_backward
+
+    p, x, positions, out, lse2d = res
+    (q, k, v), qkv_vjp = jax.vjp(
+        lambda p_, x_: attention_qkv(config, p_, x_, positions), p, x
+    )
+    if os.environ.get(
+        "DLROVER_TPU_FLASH_BWD", "pallas"
+    ).lower() == "xla":
+        # Same debug fallback as pallas_attention._bwd: rebuild the
+        # attention grads through the XLA reference op so the knob
+        # keeps working under the lite path too.
+        _, attn_vjp = jax.vjp(
+            lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True
+            ),
+            q, k, v,
+        )
+        dq, dk, dv = attn_vjp(g)
+    else:
+        lse = jnp.broadcast_to(
+            lse2d[:, :, None], lse2d.shape + (LANES,)
+        )
+        interpret = jax.default_backend() != "tpu"
+        dq, dk, dv = _flash_backward(
+            q, k, v, out, lse, g, True, None, interpret
+        )
+    dp, dx = qkv_vjp((dq, dk, dv))
+    dpos = np.zeros(positions.shape, jax.dtypes.float0)
+    return dp, dx, dpos
+
+
+_attn_block_lite.defvjp(_attn_block_lite_fwd, _attn_block_lite_bwd)
+
+
 def run_layer_stack(
     config: TpuLMConfig,
     layer_params,
@@ -507,11 +576,6 @@ def run_layer_stack(
         flank_policy = (
             dots_policy if config.remat_policy == "mlp_only" else None
         )
-        attn_fn = attention_fn or dot_product_attention
-        ckpt_qkv = jax.checkpoint(
-            functools.partial(attention_qkv, config),
-            policy=flank_policy,
-        )
 
         def out_mlp(p, attn, residual):
             with jax.named_scope("attn"):
@@ -520,14 +584,35 @@ def run_layer_stack(
 
         ckpt_out_mlp = jax.checkpoint(out_mlp, policy=flank_policy)
 
-        def body(carry, pl):
-            with jax.named_scope("attn"):
-                q, k, v = ckpt_qkv(pl, carry, positions)
-                attn = attn_fn(
-                    q, k, v, causal=True,
-                    q_positions=positions, kv_positions=positions,
-                )
-            return ckpt_out_mlp(pl, attn, carry)
+        if config.remat_policy == "attn_save" and getattr(
+            attention_fn, "is_plain_flash", False
+        ):
+            # The memory-tight policy uses the lite block: residuals
+            # are (x, out, lse) instead of (q, k, v, out, lse) — what
+            # makes 64k-token training compile on one 16GB chip (see
+            # _attn_block_lite). Independent of the passed
+            # attention_fn by construction: is_plain_flash asserts the
+            # fn IS the default flash kernel.
+            def body(carry, pl):
+                with jax.named_scope("attn"):
+                    attn = _attn_block_lite(config, pl, carry, positions)
+                return ckpt_out_mlp(pl, attn, carry)
+
+        else:
+            attn_fn = attention_fn or dot_product_attention
+            ckpt_qkv = jax.checkpoint(
+                functools.partial(attention_qkv, config),
+                policy=flank_policy,
+            )
+
+            def body(carry, pl):
+                with jax.named_scope("attn"):
+                    q, k, v = ckpt_qkv(pl, carry, positions)
+                    attn = attn_fn(
+                        q, k, v, causal=True,
+                        q_positions=positions, kv_positions=positions,
+                    )
+                return ckpt_out_mlp(pl, attn, carry)
 
     else:
         def body(carry, pl):

@@ -572,4 +572,12 @@ def make_flash_attention(interpret: Optional[bool] = None):
     # Backward residuals are O(s*d) (q/k/v/out + compact lse), so the
     # "mlp_only" remat policy may exempt this impl from rematerialization.
     attention_fn.saveable_residuals = True
+    # Plain contiguous-position flash with DEFAULT interpret
+    # resolution: eligible for llama's lite attention block (attn_save
+    # saves only x/out/lse and re-derives q/k/v in the backward). An
+    # explicit interpret override opts out — the lite block resolves
+    # interpret from the backend and must not silently discard the
+    # caller's choice. Ring attention sets saveable_residuals but not
+    # this — its hop structure can't be re-derived from x.
+    attention_fn.is_plain_flash = interpret is None
     return attention_fn

@@ -99,8 +99,24 @@ def test_mlp_only_remat_matches_dots():
     g_dots = grads("dots", flash)
     g_mlp = grads("mlp_only", flash)
     # attn_save (long-context policy: attention escapes, flanks fully
-    # recompute) must produce identical gradients too.
-    g_attn_save = grads("attn_save", flash)
+    # recompute) must produce identical gradients too — via the LITE
+    # block (x/out/lse residuals, projections re-derived in the
+    # backward), which only engages for default-constructed flash
+    # (is_plain_flash; an explicit interpret override opts out).
+    flash_default = make_flash_attention()
+    assert flash_default.is_plain_flash
+    assert not flash.is_plain_flash  # explicit interpret opts out
+    g_attn_save = grads("attn_save", flash_default)
+    # The escape path with an explicit-interpret flash (lite bypassed)
+    # must also match.
+    g_attn_save_escape = grads("attn_save", flash)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        g_attn_save,
+        g_attn_save_escape,
+    )
     for other in (g_mlp, g_attn_save):
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_allclose(
