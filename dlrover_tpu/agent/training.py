@@ -120,6 +120,18 @@ class ElasticAgent:
         self._last_resource_report = 0.0
         self._current_outcome: Optional[RendezvousOutcome] = None
         self._stopping = False
+        self._workers_started_at = 0.0
+        from dlrover_tpu.observability.registry import default_registry
+
+        registry = default_registry()
+        self._restarts_counter = registry.counter(
+            "agent_worker_restarts_total",
+            "worker restarts performed by this agent",
+        )
+        self._failures_counter = registry.counter(
+            "agent_worker_failures_total",
+            "worker failures observed by this agent",
+        )
 
     # ---- worker lifecycle --------------------------------------------------
 
@@ -212,6 +224,7 @@ class ElasticAgent:
 
     def _start_workers_inner(self, outcome: RendezvousOutcome, spec):
         self._workers = []
+        self._workers_started_at = time.time()
         for local_rank in range(spec.nproc_per_node):
             env = self._base_worker_env(spec)
             env.update(self._outcome_env(outcome, local_rank, spec))
@@ -406,6 +419,7 @@ class ElasticAgent:
         restart_start = time.time()
         self._stop_workers(post_mortem=post_mortem)
         self._restart_count += 1
+        self._restarts_counter.inc()
         self._initialize_workers()
         self._client.report_goodput_phase(
             GoodputPhase.RESTART, restart_start, time.time()
@@ -460,9 +474,66 @@ class ElasticAgent:
 
     # ---- failure handling --------------------------------------------------
 
+    def collect_flight_records(
+        self, local_ranks=None, last_n: int = 64
+    ) -> Dict[int, Dict]:
+        """Fetch the flight-recorder crash dumps of this node's workers
+        (the last N steps each dead worker managed to record). Dumps
+        older than the current incarnation are skipped: a SIGKILLed
+        worker writes nothing, and reporting the PREVIOUS incarnation's
+        ring as this failure's postmortem would mislead diagnosis."""
+        from dlrover_tpu.observability import flight_recorder
+
+        if local_ranks is None:
+            local_ranks = range(self._spec.nproc_per_node)
+        # Cutoff AT the incarnation start: the previous incarnation
+        # always dumps before _start_workers_inner stamps the new
+        # start time, so its file's mtime lands before the cutoff.
+        started = getattr(self, "_workers_started_at", 0.0)
+        max_age = max(time.time() - started, 0.0) if started else None
+        return flight_recorder.collect_dumps(
+            self._spec.node_rank,
+            local_ranks,
+            max_age_s=max_age,
+            last_n=last_n,
+        )
+
+    def _report_flight_records(self, codes: Dict[int, int]):
+        """Forward dead workers' last-steps rings to the master's
+        diagnosis store; best-effort — postmortem data must never delay
+        the restart path."""
+        try:
+            dumps = self.collect_flight_records(local_ranks=codes.keys())
+        except Exception:  # noqa: BLE001 - diagnosis best-effort
+            logger.warning("flight record collection failed", exc_info=True)
+            return
+        from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+
+        for local_rank, dump in dumps.items():
+            steps = dump.get("steps", [])
+            if steps:
+                logger.info(
+                    "flight recorder (local_rank %d): last step %s",
+                    local_rank,
+                    steps[-1],
+                )
+            try:
+                self._client.report_diagnosis_data(
+                    DiagnosisDataType.FLIGHT_RECORDER,
+                    {
+                        "node_rank": self._spec.node_rank,
+                        "local_rank": local_rank,
+                        "steps": steps,
+                    },
+                )
+            except Exception:  # noqa: BLE001
+                logger.debug("flight record report failed", exc_info=True)
+
     def _on_workers_failed(self) -> Optional[RunResult]:
         codes = self._failed_exit_codes()
         logger.warning("worker failure, exit codes %s", codes)
+        self._failures_counter.inc()
+        self._report_flight_records(codes)
         if self._ckpt_saver is not None:
             # Breakpoint save runs in the background: a same-host
             # restart restores MEMORY-FIRST from the shm image (owned

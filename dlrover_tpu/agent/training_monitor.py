@@ -52,6 +52,7 @@ class TrainingMonitor:
         self._path = metrics_path
         self._interval = interval
         self._offset = 0  # BYTE offset (the file is read in binary)
+        self._inode: Optional[int] = None
         self._last_reported = -1
         self._start_ts: Optional[float] = None
         self._stopped = threading.Event()
@@ -59,6 +60,13 @@ class TrainingMonitor:
         # poll_once is called from the tail thread AND from shutdown
         # flushes; the offset bookkeeping must never run concurrently.
         self._poll_lock = threading.Lock()
+        from dlrover_tpu.observability.registry import default_registry
+
+        self._resets_counter = default_registry().counter(
+            "training_monitor_tail_resets_total",
+            "metrics-file truncations/rotations the tail loop recovered "
+            "from",
+        )
 
     def start(self):
         if self._thread is None:
@@ -73,19 +81,33 @@ class TrainingMonitor:
         if self._thread is not None:
             self._thread.join(timeout=self._interval + 5)
 
+    def _reset_tail(self):
+        """Back to the top of the (new) file; a restarted worker may
+        REPLAY earlier steps (resumed from its checkpoint) — the step
+        watermark must reset with the offset or the master sees a
+        frozen global step for the whole replayed range."""
+        self._offset = 0
+        self._last_reported = -1
+        self._start_ts = None
+        self._resets_counter.inc()
+
     def _read_new_records(self):
         try:
-            size = os.path.getsize(self._path)
+            stat = os.stat(self._path)
         except OSError:
             return []
+        size = stat.st_size
+        if self._inode is None:
+            self._inode = stat.st_ino
+        elif stat.st_ino != self._inode:
+            # Rotated (rename + recreate): the new file can be LARGER
+            # than the old offset, so a size check alone would silently
+            # read from the middle of it forever.
+            self._inode = stat.st_ino
+            self._reset_tail()
         if size < self._offset:
-            # Truncated/rotated: a restarted worker may REPLAY earlier
-            # steps (resumed from its checkpoint) — the step watermark
-            # must reset with the offset or the master sees a frozen
-            # global step for the whole replayed range.
-            self._offset = 0
-            self._last_reported = -1
-            self._start_ts = None
+            # Truncated in place.
+            self._reset_tail()
         if size == self._offset:
             return []
         # Binary read: offsets are byte positions, immune to non-ASCII

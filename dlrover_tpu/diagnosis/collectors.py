@@ -16,14 +16,27 @@ from typing import Dict, Optional
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
 
+# The labels group admits '}' INSIDE quoted values (kernel names are
+# arbitrary strings): any run of non-quote/non-brace chars or a full
+# quoted string, repeated.
 _METRIC_LINE = re.compile(
     r'^(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?:\{name="(?P<name>[^"]*)"\})?\s+(?P<value>[-+0-9.eE]+)\s*$'
+    r'(?:\{(?P<labels>(?:[^"{}]|"(?:[^"\\]|\\.)*")*)\})?'
+    r'\s+(?P<value>[-+0-9.eE]+|NaN|[+-]?Inf)\s*$'
 )
+_LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
 def parse_prometheus_text(text: str) -> Dict[str, float]:
-    """Flatten Prometheus exposition into {metric[/name]: value}."""
+    """Flatten Prometheus exposition into {metric[/labels]: value}.
+
+    A bare metric keeps its name; the single-label ``{name="X"}``
+    convention every in-repo exporter uses (tpu_timer daemon, the
+    master's /metrics) flattens to ``metric/X`` — unchanged from the
+    original parser; any other label set flattens to
+    ``metric/k1=v1,k2=v2`` in exposition order (histogram ``le``
+    buckets and multi-label families survive the round trip).
+    """
     out: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -33,8 +46,17 @@ def parse_prometheus_text(text: str) -> Dict[str, float]:
         if not m:
             continue
         key = m.group("metric")
-        if m.group("name"):
-            key = f"{key}/{m.group('name')}"
+        raw_labels = m.group("labels")
+        if raw_labels:
+            pairs = [
+                (k, v.replace('\\"', '"').replace("\\\\", "\\"))
+                for k, v in _LABEL_PAIR.findall(raw_labels)
+            ]
+            if len(pairs) == 1 and pairs[0][0] == "name":
+                key = f"{key}/{pairs[0][1]}"
+            elif pairs:
+                flat = ",".join(f"{k}={v}" for k, v in pairs)
+                key = f"{key}/{flat}"
         try:
             out[key] = float(m.group("value"))
         except ValueError:

@@ -17,6 +17,7 @@ class DiagnosisDataType:
     TRAINING_METRIC = "training_metric"
     RESOURCE = "resource"
     XPU_TIMER_METRIC = "xpu_timer_metric"
+    FLIGHT_RECORDER = "flight_recorder"
 
 
 @dataclass
@@ -61,6 +62,16 @@ class XpuTimerMetric(DiagnosisData):
     metrics: Dict[str, float] = field(default_factory=dict)
 
 
+@dataclass
+class FlightRecord(DiagnosisData):
+    """The last N step records of a dead worker, fetched by the agent
+    from the flight recorder's crash dump."""
+
+    data_type: str = DiagnosisDataType.FLIGHT_RECORDER
+    local_rank: int = -1
+    steps: List[Dict] = field(default_factory=list)
+
+
 def build_diagnosis_data(data_type, node_id, payload, timestamp=0.0):
     """Reconstruct a DiagnosisData from the generic RPC report
     (comm.DiagnosisDataReport: data_type + free-form payload dict)."""
@@ -69,6 +80,7 @@ def build_diagnosis_data(data_type, node_id, payload, timestamp=0.0):
         DiagnosisDataType.TRAINING_METRIC: WorkerTrainingMetric,
         DiagnosisDataType.RESOURCE: NodeResourceData,
         DiagnosisDataType.XPU_TIMER_METRIC: XpuTimerMetric,
+        DiagnosisDataType.FLIGHT_RECORDER: FlightRecord,
     }
     cls = classes.get(data_type)
     if cls is None:

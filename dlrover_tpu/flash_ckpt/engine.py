@@ -105,6 +105,17 @@ class CheckpointEngine:
         from dlrover_tpu.flash_ckpt.autotune import SaveCostTracker
 
         self.cost_tracker = SaveCostTracker()
+        from dlrover_tpu.observability.registry import default_registry
+
+        registry = default_registry()
+        self._saves_counter = registry.counter(
+            "flash_ckpt_memory_saves_total",
+            "flash checkpoint shm saves completed",
+        )
+        self._save_block_hist = registry.histogram(
+            "flash_ckpt_save_block_seconds",
+            "training-thread seconds blocked per shm save",
+        )
 
     # ---- save --------------------------------------------------------------
 
@@ -124,6 +135,7 @@ class CheckpointEngine:
         elapsed = self._save_to_memory(step, state, user_meta)
         if elapsed > 0.0:
             self.cost_tracker.record_block(elapsed)
+            self._save_block_hist.observe(elapsed)
         return elapsed
 
     def _save_to_memory(
@@ -184,6 +196,7 @@ class CheckpointEngine:
         self._last_save_time = time.time()
         self._last_written_step = max(self._last_written_step, step)
         self.cost_tracker.record_drain(elapsed)
+        self._saves_counter.inc()
         logger.info(
             "flash ckpt step %d -> shm in %.3fs", step, elapsed
         )

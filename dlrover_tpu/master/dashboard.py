@@ -158,11 +158,13 @@ class DashboardServer:
         port: int = 0,
         rdzv_managers=None,
         task_manager=None,
+        metric_context=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
         self._rdzv_managers = rdzv_managers or {}
         self._task_manager = task_manager
+        self._metric_context = metric_context
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self.port = 0
@@ -205,6 +207,22 @@ class DashboardServer:
                         json.dumps(dashboard._datasets()),
                         "application/json",
                     )
+                elif self.path == "/metrics":
+                    # One Prometheus scrape covers the whole job:
+                    # process registry (event-drop counters, phase
+                    # second counters, ...) + live goodput/speed + the
+                    # per-node daemon aggregates the master scraped.
+                    self._send(
+                        200,
+                        dashboard._metrics_text(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif self.path == "/api/phases":
+                    self._send(
+                        200,
+                        json.dumps(dashboard._phases()),
+                        "application/json",
+                    )
                 elif self.path.startswith("/api/node/"):
                     detail = dashboard._node_detail(
                         self.path.rsplit("/", 1)[-1]
@@ -239,11 +257,30 @@ class DashboardServer:
         }
 
     def _perf(self):
-        return {
+        perf = {
             "global_step": self._perf_monitor.global_step,
             "speed": self._perf_monitor.running_speed(),
             "goodput": self._perf_monitor.goodput(),
         }
+        breakdown = getattr(self._perf_monitor, "phase_breakdown", None)
+        if callable(breakdown):
+            perf["phase_breakdown"] = breakdown()
+            perf["phase_fractions"] = breakdown(as_fractions=True)
+        return perf
+
+    def _phases(self):
+        records = getattr(self._perf_monitor, "phase_records", None)
+        if callable(records):
+            return records()
+        return {"init_time": 0.0, "max_phase_end": 0.0, "records": []}
+
+    def _metrics_text(self):
+        from dlrover_tpu.observability.prom import master_metrics_text
+
+        return master_metrics_text(
+            perf_monitor=self._perf_monitor,
+            metric_context=self._metric_context,
+        )
 
     def _nodes(self):
         all_nodes = self._all_nodes()

@@ -183,6 +183,13 @@ class DistributedJobMaster:
                 dashboard_port,
                 rdzv_managers=self.rdzv_managers,
                 task_manager=self.task_manager,
+                # /metrics also exposes the out-of-band daemon
+                # aggregates when the metric monitor is on.
+                metric_context=(
+                    self.metric_monitor.context
+                    if self.metric_monitor is not None
+                    else None
+                ),
             )
         self.auto_scaler = None
         if auto_scale:

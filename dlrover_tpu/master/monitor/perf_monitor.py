@@ -10,13 +10,14 @@ north-star metric.
 import threading
 import time
 from collections import defaultdict, deque
-from typing import Deque, Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import GoodputPhase
+from dlrover_tpu.observability.registry import default_registry
 
 
 class PerfMonitor:
-    def __init__(self, speed_window: int = 30):
+    def __init__(self, speed_window: int = 30, max_phase_records: int = 4096):
         self._lock = threading.Lock()
         self._start_time = time.time()
         self._global_step = 0
@@ -28,8 +29,24 @@ class PerfMonitor:
         self._phase_secs: Dict[str, Dict[int, float]] = defaultdict(
             lambda: defaultdict(float)
         )
+        # Raw (node, phase, start, end) intervals, bounded: the timeline
+        # merger needs the intervals themselves, not just the sums.
+        # Evictions are counted — after one, the records can no longer
+        # reproduce goodput() exactly and consumers must know.
+        self._phase_records: Deque[Dict] = deque(maxlen=max_phase_records)
+        self._phase_records_dropped = 0
         self._max_phase_end = 0.0
         self._init_time = time.time()
+        registry = default_registry()
+        self._phase_secs_counter = registry.counter(
+            "dlrover_goodput_phase_seconds_total",
+            "wall seconds attributed to each goodput phase",
+            labelnames=("name",),
+        )
+        self._step_reports_counter = registry.counter(
+            "dlrover_step_reports_total",
+            "global-step reports received by the master",
+        )
 
     # ---- step speed --------------------------------------------------------
 
@@ -47,6 +64,7 @@ class PerfMonitor:
             self._global_step = max(self._global_step, step)
             if elapsed_train_secs > 0:
                 self._total_train_secs += elapsed_train_secs
+        self._step_reports_counter.inc()
 
     @property
     def global_step(self) -> int:
@@ -75,7 +93,18 @@ class PerfMonitor:
             return
         with self._lock:
             self._phase_secs[phase][node_id] += end - start
+            if len(self._phase_records) == self._phase_records.maxlen:
+                self._phase_records_dropped += 1
+            self._phase_records.append(
+                {
+                    "node_id": node_id,
+                    "phase": phase,
+                    "start": start,
+                    "end": end,
+                }
+            )
             self._max_phase_end = max(self._max_phase_end, end)
+        self._phase_secs_counter.inc(end - start, name=phase)
 
     def goodput(self) -> float:
         """Fraction of wall time spent in productive training, averaged
@@ -88,11 +117,32 @@ class PerfMonitor:
             ratios = [min(t / wall, 1.0) for t in per_node.values()]
             return sum(ratios) / len(ratios)
 
-    def phase_breakdown(self) -> Dict[str, float]:
+    def phase_breakdown(self, as_fractions: bool = False) -> Dict[str, float]:
         with self._lock:
-            return {
+            totals = {
                 phase: sum(nodes.values())
                 for phase, nodes in self._phase_secs.items()
+            }
+        if not as_fractions:
+            return totals
+        grand = sum(totals.values())
+        if grand <= 0:
+            return {phase: 0.0 for phase in totals}
+        return {phase: secs / grand for phase, secs in totals.items()}
+
+    def phase_records(self) -> Dict:
+        """The raw goodput ledger for the timeline merger: the recorded
+        (node, phase, start, end) intervals plus the accounting origin,
+        so ``trace_merge.reconstruct_goodput`` can reproduce
+        :meth:`goodput` exactly — as long as ``records_dropped`` is 0;
+        past the ring bound the reconstruction is partial and the merge
+        tool downgrades its goodput cross-check to a warning."""
+        with self._lock:
+            return {
+                "init_time": self._init_time,
+                "max_phase_end": self._max_phase_end,
+                "records_dropped": self._phase_records_dropped,
+                "records": [dict(r) for r in self._phase_records],
             }
 
     def reset(self):
@@ -101,5 +151,7 @@ class PerfMonitor:
             self._last_step_report = None
             self._speed_records.clear()
             self._phase_secs.clear()
+            self._phase_records.clear()
+            self._phase_records_dropped = 0
             self._init_time = time.time()
             self._max_phase_end = 0.0

@@ -1,0 +1,27 @@
+"""Unified observability hub.
+
+One subsystem closes the loop between the raw signals this repo already
+emits (training_event JSONL spans, tpu_timer chrome traces, the master's
+goodput phase ledger) and the two artifacts an operator actually wants
+from a job: ONE Prometheus scrape (`/metrics` on the master dashboard)
+and ONE merged timeline (``tools/merge_timeline.py``).
+
+- :mod:`registry` — process-wide, thread-safe metrics registry
+  (counters/gauges/histograms with labels) every component reports into.
+- :mod:`prom` — Prometheus text exposition for the registry plus the
+  master's live job-level metrics (goodput, phase seconds, speed).
+- :mod:`flight_recorder` — fixed-size ring of per-step timing records
+  kept off the jitted path, dumped as JSON on crash/SIGTERM so the last
+  N steps of a dead worker survive for diagnosis.
+- :mod:`trace_merge` — clock-offset-aligned fusion of all signal
+  sources into a single chrome-trace/Perfetto JSON per job.
+"""
+
+from dlrover_tpu.observability.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    reset_default_registry,
+)

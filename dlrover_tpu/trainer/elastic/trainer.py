@@ -41,6 +41,7 @@ class ElasticTrainer:
         dp_size: int,
         master_client=None,
         report_interval_s: float = 15.0,
+        flight_recorder=None,
     ):
         self.batch_config = batch_config
         self.dp_size = dp_size
@@ -50,6 +51,17 @@ class ElasticTrainer:
         self.global_step = 0
         self._train_started = 0.0
         self._last_report = 0.0
+        self._last_step_ts = 0.0
+        # Per-step flight recording: explicit recorder, else whatever
+        # runtime.init_distributed armed for this process (never create
+        # one here — library code must not grab crash hooks).
+        if flight_recorder is None:
+            from dlrover_tpu.observability.flight_recorder import (
+                active_recorder,
+            )
+
+            flight_recorder = active_recorder()
+        self._flight_recorder = flight_recorder
 
     # ---- re-scale ------------------------------------------------------------
 
@@ -76,10 +88,28 @@ class ElasticTrainer:
 
     def start_training(self):
         self._train_started = time.time()
+        self._last_step_ts = self._train_started
 
-    def step_completed(self, steps: int = 1):
+    def step_completed(
+        self,
+        steps: int = 1,
+        data_wait_s: float = 0.0,
+        ckpt_block_s: float = 0.0,
+    ):
         self.global_step += steps
         now = time.time()
+        if self._flight_recorder is not None:
+            # Host-side bookkeeping between steps — nothing here touches
+            # the jitted path. Step wall time is the gap since the last
+            # completion (covers dispatch + device + data).
+            prev = self._last_step_ts or now
+            self._flight_recorder.record_step(
+                self.global_step,
+                step_time_s=max(now - prev, 0.0) / max(steps, 1),
+                data_wait_s=data_wait_s,
+                ckpt_block_s=ckpt_block_s,
+            )
+        self._last_step_ts = now
         if (
             self._client is not None
             and now - self._last_report > self._report_interval_s

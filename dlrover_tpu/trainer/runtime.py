@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from dlrover_tpu.common.constants import WorkerEnv
+from dlrover_tpu.common.constants import NodeEnv, WorkerEnv
 from dlrover_tpu.common.log import logger
 
 
@@ -100,8 +100,32 @@ def init_distributed(timeout_secs: int = 300) -> DistributedContext:
             exc_info=True,
         )
     _maybe_start_tpu_timer(ctx)
+    _setup_flight_recorder(ctx)
     _context = ctx
     return ctx
+
+
+def _setup_flight_recorder(ctx: DistributedContext):
+    """Arm the per-step flight recorder: a host-side ring buffer (never
+    touches the jitted path) dumped as JSON on crash/SIGTERM at a path
+    the agent can reconstruct from (node_rank, local_rank), so the last
+    N steps of a dead worker survive for diagnosis."""
+    try:
+        from dlrover_tpu.observability import flight_recorder
+
+        node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        flight_recorder.install_recorder(
+            node_rank=node_rank,
+            local_rank=ctx.local_rank,
+            meta={
+                "process_id": ctx.process_id,
+                "num_processes": ctx.num_processes,
+                "restart_count": ctx.restart_count,
+                "rdzv_round": ctx.rdzv_round,
+            },
+        )
+    except Exception:
+        logger.warning("flight recorder unavailable", exc_info=True)
 
 
 def _maybe_start_tpu_timer(ctx: DistributedContext):

@@ -1,0 +1,644 @@
+"""Observability hub: registry, exposition, flight recorder, /metrics,
+exporter loss accounting, and the tail-loop recovery paths."""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.diagnosis.collectors import parse_prometheus_text
+from dlrover_tpu.master.dashboard import DashboardServer
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.observability import prom
+from dlrover_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    collect_dumps,
+    dump_path,
+    load_dump,
+)
+from dlrover_tpu.observability.registry import MetricsRegistry
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests", labelnames=("name",))
+    c.inc(name="a")
+    c.inc(2.5, name="a")
+    c.inc(name="b")
+    assert c.value(name="a") == 3.5
+    assert c.value(name="b") == 1.0
+    with pytest.raises(ValueError):
+        c.inc(-1, name="a")
+
+    g = reg.gauge("temp")
+    g.set(7.0)
+    g.inc(3.0)
+    g.dec(1.0)
+    assert g.value() == 9.0
+
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(55.55)
+    samples = {
+        (name, labels.get("le")): value
+        for name, labels, value in h.samples()
+        if name.endswith("_bucket")
+    }
+    assert samples[("lat_seconds_bucket", "0.1")] == 1
+    assert samples[("lat_seconds_bucket", "1.0")] == 2
+    assert samples[("lat_seconds_bucket", "10.0")] == 3
+    assert samples[("lat_seconds_bucket", "+Inf")] == 4
+
+
+def test_registration_idempotent_but_type_checked():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x_total")
+    c2 = reg.counter("x_total")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError):
+        c1.inc(name="oops")  # undeclared label
+    with pytest.raises(ValueError):
+        # Conflicting label declaration fails at registration, not at
+        # some later update site.
+        reg.counter("x_total", labelnames=("name",))
+    reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", buckets=(5.0,))
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("spins_total")
+
+    def spin():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+# ---- exposition round-trip --------------------------------------------------
+
+
+def test_render_round_trips_through_in_repo_parser():
+    reg = MetricsRegistry()
+    reg.counter("drops_total", "drops").inc(3)
+    reg.gauge("speed", labelnames=("name",)).set(1.25, name="train")
+    reg.histogram("block_seconds", buckets=(0.5,)).observe(0.2)
+    multi = reg.counter("multi_total", labelnames=("job", "role"))
+    multi.inc(7, job="j1", role="worker")
+    text = prom.render_registry(reg)
+    parsed = parse_prometheus_text(text)
+    assert parsed["drops_total"] == 3
+    assert parsed["speed/train"] == 1.25
+    assert parsed["block_seconds_bucket/le=0.5"] == 1
+    assert parsed["block_seconds_count"] == 1
+    assert parsed["block_seconds_sum"] == pytest.approx(0.2)
+    assert parsed["multi_total/job=j1,role=worker"] == 7
+
+
+def test_parser_still_reads_tpu_timer_style_and_bare_lines():
+    text = (
+        "# HELP x y\n"
+        'tpu_timer_counter{name="steps"} 42\n'
+        "tpu_timer_hang_spans 0\n"
+        # Kernel names are arbitrary strings: a '}' INSIDE a quoted
+        # value must not end the label set.
+        'tpu_timer_span_count{name="fusion}1"} 3\n'
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed == {
+        "tpu_timer_counter/steps": 42.0,
+        "tpu_timer_hang_spans": 0.0,
+        "tpu_timer_span_count/fusion}1": 3.0,
+    }
+
+
+# ---- master /metrics --------------------------------------------------------
+
+
+class _FakeJobManager:
+    def get_job_detail(self):
+        raise NotImplementedError
+
+
+def test_master_metrics_endpoint_one_scrape_covers_the_job():
+    from dlrover_tpu.common.metric import JobMetricContext
+    from dlrover_tpu.training_event.exporter import AsyncFileExporter
+
+    from dlrover_tpu.observability.registry import default_registry
+
+    perf = PerfMonitor()
+    now = time.time()
+    perf._init_time = now - 100  # deterministic wall for goodput
+    phase_counter = default_registry().counter(
+        "dlrover_goodput_phase_seconds_total", labelnames=("name",)
+    )
+    train_secs_before = phase_counter.value(name="train")
+    perf.collect_global_step(10, now - 50)
+    perf.collect_global_step(20, now - 40)
+    perf.collect_phase(0, "train", now - 100, now - 20)
+    perf.collect_phase(0, "ckpt", now - 20, now - 10)
+    ctx = JobMetricContext()
+    ctx.record(0, {"tpu_timer_counter/steps": 55.0})
+    ctx.record(1, {"tpu_timer_counter/steps": 45.0})
+    # An exporter existing in-process registers the drop counters.
+    exporter = AsyncFileExporter("/tmp/dlrover_tpu_events_test")
+    exporter.close()
+
+    dash = DashboardServer(
+        _FakeJobManager(), perf, port=0, metric_context=ctx
+    )
+    dash.start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", dash.port, timeout=5
+        )
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type", "").startswith("text/plain")
+        text = resp.read().decode()
+        conn.close()
+    finally:
+        dash.stop()
+
+    parsed = parse_prometheus_text(text)
+    # Acceptance: goodput, per-phase seconds, running speed, event-drop
+    # counters — all from ONE scrape, via the in-repo parser.
+    # wall = max_phase_end - init_time = 90s, train = 80s
+    assert parsed["dlrover_goodput"] == pytest.approx(80 / 90, abs=0.02)
+    assert parsed["dlrover_goodput_phase_seconds/train"] == pytest.approx(
+        80, abs=1
+    )
+    assert parsed["dlrover_goodput_phase_seconds/ckpt"] == pytest.approx(
+        10, abs=1
+    )
+    assert parsed["dlrover_running_speed_steps_per_s"] == pytest.approx(
+        1.0, abs=0.01
+    )
+    assert parsed["dlrover_global_step"] == 20
+    assert "training_event_dropped_total" in parsed
+    assert "training_event_write_failures_total" in parsed
+    # Registry counter PerfMonitor fed while collecting (delta: the
+    # counter is process-wide and other tests feed it too).
+    assert parsed[
+        "dlrover_goodput_phase_seconds_total/train"
+    ] - train_secs_before == pytest.approx(80, abs=1)
+    # Job-level aggregates from the scraped daemon metrics.
+    assert parsed[
+        "dlrover_job_metric_mean/tpu_timer_counter/steps"
+    ] == pytest.approx(50.0)
+
+
+def test_api_perf_includes_phase_breakdown_and_speed():
+    """Satellite: /api/perf now serves the goodput phase breakdown and
+    running speed the merge cross-check consumes."""
+    perf = PerfMonitor()
+    now = time.time()
+    perf.collect_global_step(0, now - 10)
+    perf.collect_global_step(30, now)
+    perf.collect_phase(0, "train", now - 90, now - 10)
+    perf.collect_phase(0, "rendezvous", now - 100, now - 90)
+    perf.collect_phase(0, "ckpt", now - 10, now)
+
+    dash = DashboardServer(_FakeJobManager(), perf, port=0)
+    dash.start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", dash.port, timeout=5
+        )
+        conn.request("GET", "/api/perf")
+        data = json.loads(conn.getresponse().read())
+        conn.close()
+    finally:
+        dash.stop()
+    assert data["speed"] == pytest.approx(3.0, abs=0.01)
+    assert data["phase_breakdown"]["train"] == pytest.approx(80, abs=1)
+    assert data["phase_breakdown"]["rendezvous"] == pytest.approx(
+        10, abs=1
+    )
+    fracs = data["phase_fractions"]
+    assert fracs["train"] == pytest.approx(0.8, abs=0.01)
+    assert sum(fracs.values()) == pytest.approx(1.0)
+
+
+def test_api_phases_serves_the_raw_ledger():
+    perf = PerfMonitor()
+    perf.collect_phase(2, "train", 1000.0, 1080.0)
+    dash = DashboardServer(_FakeJobManager(), perf, port=0)
+    dash.start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", dash.port, timeout=5
+        )
+        conn.request("GET", "/api/phases")
+        data = json.loads(conn.getresponse().read())
+        conn.close()
+    finally:
+        dash.stop()
+    assert data["records"] == [
+        {"node_id": 2, "phase": "train", "start": 1000.0, "end": 1080.0}
+    ]
+    assert "init_time" in data
+
+
+def test_phase_breakdown_fractions():
+    """Satellite: fractions sum to 1 and track the seconds ratio."""
+    perf = PerfMonitor()
+    perf.collect_phase(0, "train", 0.0, 75.0)
+    perf.collect_phase(1, "train", 0.0, 75.0)
+    perf.collect_phase(0, "restart", 75.0, 100.0)
+    secs = perf.phase_breakdown()
+    assert secs == {"train": 150.0, "restart": 25.0}
+    fracs = perf.phase_breakdown(as_fractions=True)
+    assert fracs["train"] == pytest.approx(150 / 175)
+    assert fracs["restart"] == pytest.approx(25 / 175)
+    assert sum(fracs.values()) == pytest.approx(1.0)
+    assert PerfMonitor().phase_breakdown(as_fractions=True) == {}
+
+
+# ---- exporter loss accounting ----------------------------------------------
+
+
+def test_exporter_counts_drops_and_flushes_on_close(tmp_path):
+    from dlrover_tpu.observability.registry import default_registry
+    from dlrover_tpu.training_event.emitter import Event
+    from dlrover_tpu.training_event.exporter import AsyncFileExporter
+
+    exporter = AsyncFileExporter(str(tmp_path), max_queue=4)
+    # Stall the writer so the queue genuinely fills.
+    exporter._stopped.set()
+    exporter._thread.join(timeout=5)
+    dropped_before = default_registry().counter(
+        "training_event_dropped_total"
+    ).value()
+    for i in range(10):
+        exporter.export(Event(name=f"e{i}"))
+    dropped = (
+        default_registry().counter("training_event_dropped_total").value()
+        - dropped_before
+    )
+    assert dropped == 6  # queue held 4, the rest counted as dropped
+    # close() drains what the (dead) writer thread never wrote.
+    exporter._closed = False
+    exporter.close()
+    files = list(tmp_path.glob("events_*.jsonl"))
+    assert files
+    lines = files[0].read_text().strip().splitlines()
+    assert len(lines) == 4
+
+
+def test_exporter_counts_write_failures(tmp_path):
+    from dlrover_tpu.observability.registry import default_registry
+    from dlrover_tpu.training_event.emitter import Event
+    from dlrover_tpu.training_event.exporter import AsyncFileExporter
+
+    exporter = AsyncFileExporter(str(tmp_path))
+    failures_before = default_registry().counter(
+        "training_event_write_failures_total"
+    ).value()
+
+    class Bomb:
+        def to_json(self):
+            raise RuntimeError("boom")
+
+    exporter.export(Bomb())
+    exporter.export(Event(name="ok"))
+    exporter.close()
+    failures = (
+        default_registry()
+        .counter("training_event_write_failures_total")
+        .value()
+        - failures_before
+    )
+    assert failures == 1
+    files = list(tmp_path.glob("events_*.jsonl"))
+    assert files and "ok" in files[0].read_text()
+
+
+def test_exporter_close_idempotent(tmp_path):
+    from dlrover_tpu.training_event.exporter import AsyncFileExporter
+
+    exporter = AsyncFileExporter(str(tmp_path))
+    exporter.close()
+    exporter.close()  # second close (atexit) must be a no-op
+
+
+# ---- flight recorder --------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = FlightRecorder(capacity=8, meta={"node_rank": 0})
+    for step in range(20):
+        rec.record_step(
+            step,
+            step_time_s=0.1,
+            data_wait_s=0.01,
+            ckpt_block_s=0.0,
+            rdzv_round=1,
+        )
+    snap = rec.snapshot()
+    assert len(snap["steps"]) == 8  # bounded ring
+    assert snap["steps"][-1]["step"] == 19
+    assert snap["steps"][0]["step"] == 12
+    assert snap["meta"]["node_rank"] == 0
+    path = str(tmp_path / "flight.json")
+    assert rec.dump(path) == path
+    loaded = load_dump(path)
+    assert [s["step"] for s in loaded["steps"]] == list(range(12, 20))
+    assert rec.snapshot(last_n=3)["steps"][0]["step"] == 17
+
+
+def test_flight_recorder_stays_off_the_jitted_path():
+    """The recorder must not touch jax at all: recording happens on the
+    host between dispatches, so the module must import and run without
+    jax ever loading (anything jax-typed passed in would force a sync)."""
+    import re
+
+    import dlrover_tpu.observability.flight_recorder as fr
+
+    src = open(fr.__file__).read()
+    assert not re.search(r"^\s*(import jax|from jax)", src, re.MULTILINE)
+    code = (
+        "import sys\n"
+        "import dlrover_tpu.observability.flight_recorder as fr\n"
+        "r = fr.FlightRecorder(capacity=4)\n"
+        "r.record_step(1, step_time_s=0.1)\n"
+        "assert not any(m == 'jax' or m.startswith('jax.')\n"
+        "               for m in sys.modules), 'jax was imported'\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    out = subprocess.run(
+        [sys.executable, "-S", "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=60,
+    )
+    assert out.returncode == 0 and "OK" in out.stdout, out.stderr
+
+
+def test_flight_recorder_dump_on_worker_death_and_agent_fetch(
+    tmp_path, monkeypatch
+):
+    """Acceptance: simulated worker death (SIGTERM mid-run) -> the agent
+    retrieves the last-N-steps JSON via the shared path convention."""
+    flight_dir = str(tmp_path / "flight")
+    worker_code = (
+        "import os, time, signal\n"
+        "from dlrover_tpu.observability import flight_recorder as fr\n"
+        "rec = fr.install_recorder(node_rank=3, local_rank=0,\n"
+        "                          meta={'process_id': 3})\n"
+        "for step in range(50):\n"
+        "    rec.record_step(step, step_time_s=0.01, data_wait_s=0.002)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["DLROVER_TPU_FLIGHT_DIR"] = flight_dir
+    proc = subprocess.Popen(
+        [sys.executable, "-c", worker_code],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        # The handler re-delivers SIGTERM: exit says killed-by-signal.
+        assert rc == -signal.SIGTERM
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    monkeypatch.setenv("DLROVER_TPU_FLIGHT_DIR", flight_dir)
+    dumps = collect_dumps(3, [0], last_n=16)
+    assert 0 in dumps
+    steps = dumps[0]["steps"]
+    assert len(steps) == 16
+    assert steps[-1]["step"] == 49
+    assert steps[-1]["data_wait_s"] == pytest.approx(0.002)
+    assert dumps[0]["meta"]["process_id"] == 3
+    assert os.path.exists(dump_path(3, 0))
+
+
+def test_elastic_trainer_feeds_flight_recorder():
+    from dlrover_tpu.trainer.elastic.trainer import (
+        ElasticBatchConfig,
+        ElasticTrainer,
+    )
+
+    rec = FlightRecorder(capacity=16)
+    trainer = ElasticTrainer(
+        ElasticBatchConfig(global_batch_size=8, micro_batch_per_device=1),
+        dp_size=8,
+        flight_recorder=rec,
+    )
+    trainer.start_training()
+    trainer.step_completed(data_wait_s=0.004)
+    trainer.step_completed(ckpt_block_s=0.25)
+    steps = rec.snapshot()["steps"]
+    assert [s["step"] for s in steps] == [1, 2]
+    assert steps[0]["data_wait_s"] == pytest.approx(0.004)
+    assert steps[1]["ckpt_block_s"] == pytest.approx(0.25)
+    assert steps[1]["step_time_s"] >= 0.0
+
+
+def test_agent_collects_and_reports_flight_records(tmp_path, monkeypatch):
+    """The agent's failure path forwards the dead worker's ring to the
+    master as diagnosis data."""
+    from dlrover_tpu.agent.training import ElasticAgent, WorkerSpec
+    from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+    from dlrover_tpu.observability import flight_recorder as fr
+
+    monkeypatch.setenv("DLROVER_TPU_FLIGHT_DIR", str(tmp_path))
+    rec = FlightRecorder(capacity=8, meta={"process_id": 1})
+    for step in range(5):
+        rec.record_step(step, step_time_s=0.1)
+    rec.dump(fr.dump_path(1, 0))
+
+    reports = []
+
+    class FakeClient:
+        def report_diagnosis_data(self, data_type, payload):
+            reports.append((data_type, payload))
+
+    spec = WorkerSpec(entrypoint="x.py", node_rank=1, nproc_per_node=1)
+    agent = ElasticAgent(spec, FakeClient())
+    agent._report_flight_records({0: 1})
+    assert len(reports) == 1
+    data_type, payload = reports[0]
+    assert data_type == DiagnosisDataType.FLIGHT_RECORDER
+    assert payload["node_rank"] == 1
+    assert payload["local_rank"] == 0
+    assert [s["step"] for s in payload["steps"]] == list(range(5))
+
+
+# ---- training monitor recovery (satellite) ---------------------------------
+
+
+class _StepClient:
+    def __init__(self):
+        self.reports = []
+
+    def report_global_step(self, step, elapsed):
+        self.reports.append(step)
+
+
+def _write_steps(path, steps, mode="a"):
+    with open(path, mode) as f:
+        for s in steps:
+            f.write(json.dumps({"step": s, "ts": time.time()}) + "\n")
+
+
+def test_training_monitor_recovers_from_truncation(tmp_path):
+    from dlrover_tpu.agent.training_monitor import TrainingMonitor
+
+    path = str(tmp_path / "metrics.jsonl")
+    _write_steps(path, [1, 2, 3])
+    client = _StepClient()
+    mon = TrainingMonitor(client, path)
+    assert mon.poll_once() == 3
+    # Truncate in place (restarted worker replaying from its ckpt).
+    _write_steps(path, [1, 2], mode="w")
+    assert mon.poll_once() == 2
+    assert client.reports == [3, 2]
+
+
+def test_training_monitor_recovers_from_rotation(tmp_path):
+    """Rotation to a LARGER file: the byte offset lands mid-file, which
+    the old size-only check could never detect."""
+    from dlrover_tpu.agent.training_monitor import TrainingMonitor
+
+    path = str(tmp_path / "metrics.jsonl")
+    _write_steps(path, [7])
+    client = _StepClient()
+    mon = TrainingMonitor(client, path)
+    assert mon.poll_once() == 7
+    # Rotate: rename away, recreate bigger than the old offset.
+    os.rename(path, path + ".1")
+    _write_steps(
+        path, [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], mode="w"
+    )
+    assert mon.poll_once() == 12
+    assert client.reports == [7, 12]
+
+
+# ---- dump CLI (satellite) ---------------------------------------------------
+
+
+class _FlakyDaemon:
+    """Refuses the first N /timeline fetches, then serves a trace."""
+
+    def __init__(self, fail_first: int):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        self.calls = 0
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                daemon.calls += 1
+                if daemon.calls <= fail_first:
+                    self.send_error(503)
+                    return
+                body = json.dumps(
+                    {
+                        "traceEvents": [
+                            {
+                                "name": "train_step",
+                                "ph": "X",
+                                "ts": 1000.0,
+                                "dur": 500.0,
+                                "pid": 1,
+                                "tid": 1,
+                            }
+                        ]
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_dump_retries_until_daemon_up_and_streams_stdout(
+    tmp_path, capsys, monkeypatch
+):
+    from dlrover_tpu.tpu_timer import dump as dump_mod
+
+    daemon = _FlakyDaemon(fail_first=2)
+    monkeypatch.setattr(dump_mod.time, "sleep", lambda s: None)
+    try:
+        rc = dump_mod.main(
+            [
+                "--port",
+                str(daemon.port),
+                "--retries",
+                "3",
+                "--backoff",
+                "0.01",
+                "--out",
+                "-",
+            ]
+        )
+    finally:
+        daemon.stop()
+    assert rc == 0
+    assert daemon.calls == 3
+    out = capsys.readouterr().out
+    trace = json.loads(out)
+    # The clock anchor the merge tool aligns on is embedded at fetch.
+    assert "epoch_minus_mono_us" in trace["clock_sync"]
+    assert trace["traceEvents"][0]["name"] == "train_step"
+
+
+def test_dump_no_retries_fails_fast(tmp_path):
+    from dlrover_tpu.tpu_timer import dump as dump_mod
+
+    daemon = _FlakyDaemon(fail_first=99)
+    try:
+        rc = dump_mod.main(
+            ["--port", str(daemon.port), "--out", str(tmp_path / "t.json")]
+        )
+    finally:
+        daemon.stop()
+    assert rc == 1
+    assert daemon.calls == 1

@@ -1,0 +1,343 @@
+"""Job timeline merger: alignment, fusion, goodput cross-check, and the
+sim-cluster end-to-end smoke (one command -> one valid trace)."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import GoodputPhase
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.observability.flight_recorder import FlightRecorder
+from dlrover_tpu.observability.trace_merge import (
+    JOB_PID,
+    align_trace_events,
+    events_to_trace,
+    flight_to_trace,
+    merge_job_timeline,
+    phases_to_trace,
+    reconstruct_goodput,
+    validate_merged,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+# ---- control-plane events ---------------------------------------------------
+
+
+def _event(name, etype, ts, target="agent", event_id="", content=None):
+    return {
+        "name": name,
+        "type": etype,
+        "target": target,
+        "event_id": event_id,
+        "ts": ts,
+        "pid": 77,
+        "content": content or {},
+    }
+
+
+def test_events_begin_end_pairs_become_slices():
+    events = [
+        _event("rendezvous", "begin", 100.0, event_id="77-1"),
+        _event("rendezvous", "end", 106.5, event_id="77-1",
+               content={"success": True}),
+        _event("worker_failure", "instant", 108.0),
+    ]
+    trace = events_to_trace(events)
+    slices = [e for e in trace if e["ph"] == "X"]
+    assert len(slices) == 1
+    assert slices[0]["name"] == "rendezvous"
+    assert slices[0]["ts"] == pytest.approx(100.0 * 1e6)
+    assert slices[0]["dur"] == pytest.approx(6.5 * 1e6)
+    instants = [e for e in trace if e["ph"] == "i"]
+    assert instants[0]["name"] == "worker_failure"
+    metas = [e for e in trace if e["ph"] == "M"]
+    assert any(m["args"]["name"] == "agent" for m in metas)
+
+
+def test_unmatched_end_uses_duration_and_orphan_begin_flagged():
+    events = [
+        # End whose begin was dropped (full exporter queue): duration_s
+        # reconstructs the slice.
+        _event("ckpt_persist", "end", 50.0, event_id="9-9",
+               content={"duration_s": 4.0}),
+        # Begin whose end never came (worker died mid-span).
+        _event("start_workers", "begin", 60.0, event_id="9-10"),
+    ]
+    trace = events_to_trace(events)
+    by_name = {e["name"]: e for e in trace if e["ph"] == "X"}
+    persist = by_name["ckpt_persist"]
+    assert persist["ts"] == pytest.approx(46.0 * 1e6)
+    assert persist["dur"] == pytest.approx(4.0 * 1e6)
+    assert "start_workers (unfinished)" in by_name
+
+
+# ---- clock alignment --------------------------------------------------------
+
+
+def test_align_trace_with_clock_sync_anchor():
+    trace = {
+        "traceEvents": [
+            {"name": "train_step", "ph": "X", "ts": 1000.0,
+             "dur": 50.0, "pid": 1, "tid": 1},
+        ],
+        "clock_sync": {"epoch_minus_mono_us": 5e14},
+    }
+    events, offset = align_trace_events(trace, rank=3)
+    assert offset == 5e14
+    assert events[0]["ts"] == pytest.approx(5e14 + 1000.0)
+    assert events[0]["pid"] == 3
+
+
+def test_align_trace_epoch_heuristic_and_unanchored():
+    epoch_us = time.time() * 1e6
+    anchored = {
+        "traceEvents": [
+            {"name": "a", "ph": "X", "ts": epoch_us, "dur": 1.0,
+             "pid": 0, "tid": 0},
+        ]
+    }
+    events, offset = align_trace_events(anchored, rank=0)
+    assert offset == 0.0  # already on the epoch clock
+    unanchored = {
+        "traceEvents": [
+            {"name": "b", "ph": "X", "ts": 123.0, "dur": 1.0,
+             "pid": 0, "tid": 0},
+        ]
+    }
+    events, offset = align_trace_events(unanchored, rank=1)
+    assert offset is None  # caller places it
+
+
+# ---- flight dumps -----------------------------------------------------------
+
+
+def test_flight_steps_become_slices_with_wait_subslices():
+    rec = FlightRecorder(capacity=8)
+    rec.record_step(5, step_time_s=0.2, data_wait_s=0.05,
+                    ckpt_block_s=0.01)
+    from dlrover_tpu.observability.trace_merge import (
+        FLIGHT_STEP_TID,
+        FLIGHT_WAIT_TID,
+    )
+
+    trace = flight_to_trace(rec.snapshot(), rank=2)
+    step = next(e for e in trace if e["name"] == "step 5")
+    assert step["pid"] == 2
+    # Own thread track: kernel slices from the same rank's tpu_timer
+    # trace keep their native tids and must not share a track with
+    # partially-overlapping flight slices.
+    assert step["tid"] == FLIGHT_STEP_TID
+    assert step["dur"] == pytest.approx(0.2 * 1e6)
+    waits = {
+        e["name"]: e
+        for e in trace
+        if e["tid"] == FLIGHT_WAIT_TID and e["ph"] == "X"
+    }
+    assert waits["data_wait"]["dur"] == pytest.approx(0.05 * 1e6)
+    assert waits["ckpt_blocked"]["dur"] == pytest.approx(0.01 * 1e6)
+    # Sub-slices nest inside the step slice.
+    assert waits["data_wait"]["ts"] >= step["ts"]
+
+
+# ---- goodput lane + reconstruction -----------------------------------------
+
+
+def _ledger(now):
+    perf = PerfMonitor()
+    t0 = now - 200
+    perf._init_time = t0
+    perf.collect_phase(0, GoodputPhase.RENDEZVOUS, t0, t0 + 20)
+    perf.collect_phase(0, GoodputPhase.TRAIN, t0 + 20, t0 + 150)
+    perf.collect_phase(1, GoodputPhase.TRAIN, t0 + 25, t0 + 140)
+    perf.collect_phase(0, GoodputPhase.RESTART, t0 + 150, t0 + 170)
+    perf.collect_phase(0, GoodputPhase.TRAIN, t0 + 170, t0 + 200)
+    return perf
+
+
+def test_reconstructed_goodput_matches_perf_monitor_within_1pct():
+    perf = _ledger(time.time())
+    phases = perf.phase_records()
+    reconstructed = reconstruct_goodput(phases)
+    live = perf.goodput()
+    assert live > 0.5
+    assert reconstructed == pytest.approx(live, rel=0.01)
+
+
+def test_goodput_lane_has_phase_slices_and_counter():
+    perf = _ledger(time.time())
+    lane = phases_to_trace(perf.phase_records())
+    names = {e["name"] for e in lane if e.get("ph") == "X"}
+    assert GoodputPhase.TRAIN in names
+    assert GoodputPhase.RENDEZVOUS in names
+    counters = [e for e in lane if e.get("ph") == "C"]
+    assert counters
+    assert all(e["pid"] == JOB_PID for e in counters)
+    final = counters[-1]["args"]["goodput"]
+    assert final == pytest.approx(perf.goodput(), rel=0.01)
+
+
+# ---- validation -------------------------------------------------------------
+
+
+def test_validate_merged_catches_schema_problems():
+    assert validate_merged({}) == ["traceEvents missing or empty"]
+    bad = {
+        "traceEvents": [
+            {"ph": "X", "pid": 0, "ts": "yesterday", "dur": 1.0},
+            {"ph": "??", "pid": 0},
+        ]
+    }
+    problems = validate_merged(bad)
+    assert any("non-numeric ts" in p for p in problems)
+    assert any("bad ph" in p for p in problems)
+    assert any("process_name" in p for p in problems)
+    good = {
+        "traceEvents": [
+            {"ph": "M", "pid": 0, "name": "process_name",
+             "args": {"name": "rank 0"}},
+            {"ph": "X", "pid": 0, "tid": 0, "name": "s", "ts": 1.0,
+             "dur": 2.0},
+        ]
+    }
+    assert validate_merged(good) == []
+
+
+# ---- sim-cluster end-to-end smoke ------------------------------------------
+
+
+def test_sim_cluster_postmortem_smoke(tmp_path, monkeypatch):
+    """CI smoke: a sim-cluster job produces event + trace + flight +
+    phase artifacts; one merge_timeline.py invocation fuses them into a
+    single valid chrome trace with >= 2 rank tracks, control-plane
+    spans, kernel slices, and a goodput lane whose reconstruction
+    matches the live PerfMonitor within 1%."""
+    from dlrover_tpu.common.constants import NodeStatus, NodeType
+    from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+    from dlrover_tpu.master.node.dist_job_manager import (
+        DistributedJobManager,
+    )
+    from dlrover_tpu.testing.sim_cluster import (
+        SimCluster,
+        SimNodeWatcher,
+        SimScaler,
+    )
+    from dlrover_tpu.training_event.emitter import EventEmitter
+    from dlrover_tpu.training_event.exporter import AsyncFileExporter
+
+    # --- a sim cluster with 2 worker nodes, one of which fails --------------
+    cluster = SimCluster()
+    mgr = DistributedJobManager(
+        job_name="smoke",
+        node_groups={
+            NodeType.WORKER: NodeGroupResource(
+                count=2, node_resource=NodeResource(tpu_chips=4)
+            )
+        },
+        scaler=SimScaler("smoke", cluster),
+        watcher=SimNodeWatcher("smoke", cluster),
+    )
+    for node in mgr.worker_manager.init_nodes():
+        node.update_status(NodeStatus.RUNNING)
+
+    # --- control-plane events (agent/master) into a JSONL dir ---------------
+    events_dir = tmp_path / "events"
+    exporter = AsyncFileExporter(str(events_dir))
+    agent_em = EventEmitter("agent", exporter)
+    master_em = EventEmitter("master", exporter)
+    now = time.time()
+    with agent_em.duration("rendezvous", {"node_rank": 0}):
+        pass
+    master_em.instant("job_stage", {"stage": "RUNNING"})
+    with agent_em.duration("start_workers", {"restart_count": 0}):
+        pass
+    exporter.close()
+
+    # --- per-rank "tpu_timer" traces with the dump tool's clock anchor ------
+    epoch_minus_mono_us = (time.time() - time.monotonic()) * 1e6
+    trace_paths = []
+    for rank in range(2):
+        mono_us = time.monotonic() * 1e6
+        trace = {
+            "traceEvents": [
+                {"name": "xla/all_reduce.1", "ph": "X",
+                 "ts": mono_us - 9000, "dur": 700, "pid": 1, "tid": 1,
+                 "args": {"kind": 3}},
+                {"name": "train_step", "ph": "X",
+                 "ts": mono_us - 8000, "dur": 6000, "pid": 1, "tid": 1,
+                 "args": {"kind": 0}},
+            ],
+            "clock_sync": {"epoch_minus_mono_us": epoch_minus_mono_us},
+        }
+        path = tmp_path / f"rank{rank}.json"
+        path.write_text(json.dumps(trace))
+        trace_paths.append(str(path))
+
+    # --- flight-recorder dumps (one per rank, as if both died) --------------
+    flight_paths = []
+    for rank in range(2):
+        rec = FlightRecorder(capacity=32, meta={"process_id": rank})
+        for step in range(5):
+            rec.record_step(step, step_time_s=0.05, data_wait_s=0.005)
+        path = str(tmp_path / f"flight{rank}.json")
+        rec.dump(path)
+        flight_paths.append(path)
+
+    # --- the master's goodput ledger ----------------------------------------
+    perf = _ledger(now)
+    phases_path = tmp_path / "phases.json"
+    phases_path.write_text(json.dumps(perf.phase_records()))
+
+    # --- one merge command --------------------------------------------------
+    import merge_timeline
+
+    event_files = [str(p) for p in events_dir.glob("*.jsonl")]
+    assert event_files, "exporter produced no event files"
+    out = tmp_path / "job_timeline.json"
+    rc = merge_timeline.main(
+        [
+            "--events",
+            *event_files,
+            "--trace",
+            trace_paths[0],
+            "--trace",
+            trace_paths[1],
+            "--flight",
+            flight_paths[0],
+            "--flight",
+            flight_paths[1],
+            "--phases",
+            str(phases_path),
+            "--out",
+            str(out),
+            "--expect-goodput",
+            f"{perf.goodput():.6f}",
+            "--goodput-tolerance",
+            "0.01",
+        ]
+    )
+    assert rc == 0  # includes the goodput cross-check (exit 4 on drift)
+
+    merged = json.loads(out.read_text())
+    assert validate_merged(merged) == []
+
+    events = merged["traceEvents"]
+    pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert {0, 1} <= pids  # >= 2 rank tracks
+    names = {e.get("name") for e in events}
+    assert "rendezvous" in names  # control-plane span
+    assert "xla/all_reduce.1" in names  # kernel slice
+    assert "step 4" in names  # flight recorder steps
+    assert GoodputPhase.TRAIN in names  # goodput lane
+    assert any(e.get("ph") == "C" for e in events)  # goodput counter
+    # Kernel slices landed on the epoch clock next to everything else.
+    kernel = next(e for e in events if e["name"] == "train_step")
+    assert kernel["ts"] > 1e14
+    assert merged["metadata"]["reconstructed_goodput"] == pytest.approx(
+        perf.goodput(), abs=0.01
+    )
+    mgr.stop()
