@@ -1267,6 +1267,22 @@ def goodput_phase(platform: str):
     }
 
 
+def ckpt_io_phase():
+    """Persist/restore disk bandwidth through the real storage path:
+    the raw mmap shard format vs the legacy npz container, on a
+    synthetic sharded pytree (tools/bench_ckpt_io.py). Pure disk I/O —
+    platform-independent, so it runs even on CPU-only rounds."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_ckpt_io
+
+    mb = int(os.environ.get("BENCH_CKPT_IO_MB", "256"))
+    r = bench_ckpt_io.run_bench(total_mb=mb)
+    return {f"ckpt_io_{k}": v for k, v in r.items()}
+
+
 def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
@@ -1373,6 +1389,8 @@ _KEEP_KEYS = {
     "longctx_step_ms", "longctx_tokens_per_s",
     "longctx_mfu_pct_64k", "longctx_tokens_per_s_64k",
     "longctx_remat_64k", "ckpt_save_block_s",
+    "ckpt_io_restore_raw_mb_per_s", "ckpt_io_restore_speedup_vs_npz",
+    "ckpt_io_persist_raw_mb_per_s",
     "prev_round_diff",
 }
 
@@ -1542,6 +1560,10 @@ def main():
         result, "goodput", lambda: goodput_phase(platform),
         est_s=150, cap_s=420,
     )
+    if not fast:
+        # Disk-path bandwidth scoreboard (raw mmap format vs npz); pure
+        # host I/O, so it runs on every platform.
+        run_phase(result, "ckpt_io", ckpt_io_phase, est_s=60, cap_s=240)
     if platform != "cpu" and not fast:
         # Information-value order (VERDICT r4 #1c): headline compute +
         # CE + decode + longctx before the long tail.

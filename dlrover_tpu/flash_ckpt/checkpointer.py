@@ -82,8 +82,15 @@ class Checkpointer:
         With ``sharding_tree`` the restored arrays are placed under the
         current mesh (resharding restore); otherwise numpy arrays are
         returned (to_device=False) or default-placed jax arrays.
+
+        When the restore comes from storage, passing ``sharding_tree``
+        activates the sharding-aware partial restore: each process reads
+        only its addressable byte ranges from the mmap'd shard files and
+        host RAM stays O(local bytes) — see docs/DESIGN.md §23.
         """
-        result = self._engine.load(step)
+        result = self._engine.load(
+            step, sharding_tree=sharding_tree if to_device else None
+        )
         if result is None:
             return None
         found_step, np_state, meta = result

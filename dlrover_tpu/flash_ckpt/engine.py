@@ -116,6 +116,19 @@ class CheckpointEngine:
             "flash_ckpt_save_block_seconds",
             "training-thread seconds blocked per shm save",
         )
+        self._restore_hist = registry.histogram(
+            "flash_ckpt_restore_seconds",
+            "storage restore wall seconds (read + assembly)",
+        )
+        self._restore_bw_hist = registry.histogram(
+            "flash_ckpt_restore_mb_per_s",
+            "storage restore bandwidth (local bytes / wall seconds)",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+        )
+        self._restore_bytes = registry.counter(
+            "flash_ckpt_restore_bytes_total",
+            "bytes materialized by storage restores",
+        )
 
     # ---- save --------------------------------------------------------------
 
@@ -383,32 +396,51 @@ class CheckpointEngine:
         )
 
     def _wait_local_segments(self, step: int, timeout: float) -> bool:
-        """True once every local worker's shm segment holds >= ``step``."""
+        """True once every local worker's shm segment holds >= ``step``.
+
+        One SharedMemoryHandler per sibling is attached ONCE and polled,
+        not opened/closed every 50ms (each open is a shm_open+mmap
+        syscall pair). A lagging sibling's handler is re-attached about
+        once a second — the rare case where the sibling unlinked and
+        recreated a larger segment would otherwise pin us to the stale
+        mapping forever.
+        """
         deadline = time.time() + timeout
-        while True:
-            ready = True
-            for lr in range(self._ctx.local_world_size):
-                if lr == self._local_rank:
-                    continue  # our own save already landed
-                handler = SharedMemoryHandler(shm_segment_name(lr))
-                sibling_step = handler.get_step()
+        handlers = {
+            lr: SharedMemoryHandler(shm_segment_name(lr))
+            for lr in range(self._ctx.local_world_size)
+            if lr != self._local_rank  # our own save already landed
+        }
+        try:
+            polls = 0
+            while True:
+                ready = True
+                for lr, handler in handlers.items():
+                    if handler.get_step() < step:
+                        ready = False
+                        if polls and polls % 20 == 0:
+                            handler.close()  # re-attach next poll
+                        break
+                if ready:
+                    return True
+                if time.time() >= deadline:
+                    return False
+                polls += 1
+                time.sleep(0.05)
+        finally:
+            for handler in handlers.values():
                 handler.close()
-                if sibling_step < step:
-                    ready = False
-                    break
-            if ready:
-                return True
-            if time.time() >= deadline:
-                return False
-            time.sleep(0.05)
 
     # ---- load --------------------------------------------------------------
 
-    def load(self, step: Optional[int] = None):
-        """Return (step, np-pytree, user_meta) or None.
+    def load(self, step: Optional[int] = None, sharding_tree=None):
+        """Return (step, state, user_meta) or None.
 
         Memory-first: the shm image survives worker restarts on the same
-        host. Falls back to the committed storage checkpoint.
+        host (its leaves come back as numpy). Falls back to the committed
+        storage checkpoint; with ``sharding_tree`` the storage path is a
+        sharding-aware partial restore — only this process's addressable
+        byte ranges are read and leaves come back as placed jax Arrays.
         """
         from dlrover_tpu.training_event import TrainerEvents
 
@@ -417,7 +449,7 @@ class CheckpointEngine:
             logger.info("restored step %d from host memory", result[0])
             TrainerEvents.ckpt_restore(result[0], "memory")
             return result
-        result = self._load_from_storage(step)
+        result = self._load_from_storage(step, sharding_tree)
         if result is not None:
             logger.info("restored step %d from storage", result[0])
             TrainerEvents.ckpt_restore(result[0], "storage")
@@ -448,7 +480,9 @@ class CheckpointEngine:
             return None
         return mem_step, state, meta
 
-    def _load_from_storage(self, step: Optional[int] = None):
+    def _load_from_storage(
+        self, step: Optional[int] = None, sharding_tree=None
+    ):
         target = step
         if target is None:
             target = ckpt_storage.read_tracker(self.checkpoint_dir)
@@ -457,7 +491,17 @@ class CheckpointEngine:
         metas = ckpt_storage.load_step_meta(self.checkpoint_dir, target)
         if not metas:
             return None
-        return load_global_state(self.checkpoint_dir, target, metas)
+        start = time.time()
+        result = load_global_state(
+            self.checkpoint_dir, target, metas, sharding_tree
+        )
+        if result is not None:
+            elapsed = max(time.time() - start, 1e-9)
+            nbytes = _state_local_nbytes(result[1])
+            self._restore_hist.observe(elapsed)
+            self._restore_bytes.inc(nbytes)
+            self._restore_bw_hist.observe(nbytes / 1e6 / elapsed)
+        return result
 
     def _is_foreign_image(self, meta: dict) -> bool:
         stamped = meta.get("ckpt_dir")
@@ -552,32 +596,338 @@ def _assemble_from_shards(global_shape, dtype_name, shards):
     return out
 
 
-def load_global_state(checkpoint_dir: str, step: int, metas: Dict[int, dict]):
-    """Assemble the full global state from every process's shard files."""
+def _state_local_nbytes(state) -> int:
+    """Bytes this process materialized for ``state``: DISTINCT
+    addressable shard bytes for jax Arrays (the partial-restore
+    footprint; replicas of the same index dedupe — the restore read
+    them from disk once), full nbytes for host arrays."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array):
+            try:
+                seen = set()
+                for s in leaf.addressable_shards:
+                    key = tuple(
+                        (sl.start, sl.stop) for sl in s.index
+                    )
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    total += s.data.nbytes
+                continue
+            except Exception:
+                pass
+        if hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total
+
+
+def _norm_bounds(bounds, global_shape):
+    """Close open slice ends: ((0,None),) over (8,) -> ((0,8),)."""
+    return tuple(
+        (lo if lo is not None else 0, hi if hi is not None else dim)
+        for (lo, hi), dim in zip(bounds, global_shape)
+    )
+
+
+def _norm_index(index, global_shape):
+    """Normalize a tuple of slices (a jax shard index) to closed bounds."""
+    return tuple(
+        (s.start if s.start is not None else 0,
+         s.stop if s.stop is not None else dim)
+        for s, dim in zip(index, global_shape)
+    )
+
+
+def _intersect_bounds(a, b):
+    """Intersection of two closed bounds tuples, or None if empty."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _bounds_volume(b) -> int:
+    vol = 1
+    for lo, hi in b:
+        vol *= hi - lo
+    return vol
+
+
+def _tiles_exactly(region, inters) -> bool:
+    """True if ``inters`` (intersections already clipped to ``region``)
+    are pairwise disjoint and their volumes sum to the region's — an
+    O(h^2) proof of full coverage that replaces an O(region-bytes)
+    boolean mask for the common disjoint-shard layout."""
+    if sum(_bounds_volume(b) for b in inters) != _bounds_volume(region):
+        return False
+    for i in range(len(inters)):
+        for j in range(i + 1, len(inters)):
+            if _intersect_bounds(inters[i], inters[j]) is not None:
+                return False
+    return True
+
+
+def _needed_region_bounds(sharding, global_shape, addressable=None):
+    """The distinct index bounds THIS process must materialize for a
+    leaf under ``sharding`` — the partial-restore index set. Replicas
+    collapse; non-addressable devices' shards are never read."""
+    if addressable is None:
+        addressable = sharding.addressable_devices
+    imap = sharding.devices_indices_map(tuple(global_shape))
+    needed = {}
+    for dev, idx in imap.items():
+        if dev not in addressable:
+            continue
+        needed[_norm_index(idx, global_shape)] = True
+    return list(needed)
+
+
+class _LazyReaders:
+    """Opens a process's shard file on FIRST use, not up front: after a
+    re-mesh on a large world, a partial restore may need bytes from a
+    handful of the N proc files — eagerly opening all N (open + header
+    parse + stat each, per restoring process, against shared storage)
+    would put O(world size) metadata I/O on the hot path."""
+
+    def __init__(self, checkpoint_dir: str, step: int, pids):
+        import threading
+
+        self._dir = checkpoint_dir
+        self._step = step
+        self._pids = set(pids)
+        self._lock = threading.Lock()
+        self._open: Dict[int, Any] = {}
+        self._missing = set()
+
+    def get(self, pid: int):
+        if pid not in self._pids or pid in self._missing:
+            return None
+        with self._lock:
+            reader = self._open.get(pid)
+            if reader is None and pid not in self._missing:
+                reader = ckpt_storage.open_proc_shards(
+                    self._dir, self._step, pid
+                )
+                if reader is None:
+                    self._missing.add(pid)
+                else:
+                    self._open[pid] = reader
+            return reader
+
+    def close_all(self):
+        with self._lock:
+            for reader in self._open.values():
+                reader.close()
+            self._open.clear()
+
+
+def _index_shard_locations(metas: Dict[int, dict]):
+    """Build (leaf_info, locations) from per-process metas.
+
+    leaf_info[i] = (global_shape, dtype_name);
+    locations[i] = [(pid, key, closed shard bounds), ...].
+    """
+    first = metas[min(metas)]
+    num_leaves = len(first["leaves"])
+    leaf_info = [None] * num_leaves
+    locations = [[] for _ in range(num_leaves)]
+    for pid, meta in sorted(metas.items()):
+        for leaf_meta in meta["leaves"]:
+            i = leaf_meta.leaf_id
+            gshape = tuple(leaf_meta.global_shape)
+            leaf_info[i] = (gshape, leaf_meta.dtype)
+            for j, shard in enumerate(leaf_meta.shards):
+                locations[i].append(
+                    (pid, f"leaf{i}_shard{j}",
+                     _norm_bounds(shard.index, gshape))
+                )
+    return leaf_info, locations
+
+
+def _assemble_leaf_regions(info, shard_locs, readers, region_bounds_list):
+    """Read exactly the byte ranges covering ``region_bounds_list`` for
+    one leaf. Allocates O(region bytes) host memory — never the global
+    shape (the partial-restore guarantee). Returns {bounds: array}, or
+    None if any region is not fully covered by the stored shards.
+    """
+    from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
+
+    gshape, dtype_name = info
+    dtype = _np_dtype(dtype_name)
+    regions = {}
+    for rb in region_bounds_list:
+        shape = tuple(hi - lo for lo, hi in rb)
+        if 0 in shape:
+            # Zero-size leaf (empty optimizer slot): there are no bytes
+            # to read and no coverage to prove — _intersect_bounds
+            # treats empty extents as "no hit", which must not make the
+            # whole checkpoint unrestorable.
+            regions[rb] = np.empty(shape, dtype=dtype)
+            continue
+        # Which stored shards intersect this region? Identical
+        # intersections dedupe (a leaf replicated across P processes
+        # appears in every proc file — reading it P times would multiply
+        # disk I/O by P and the overlap would force the coverage mask).
+        hits = []
+        seen_inter = set()
+        for pid, key, sb in shard_locs:
+            # Intersect on the METADATA bounds before touching the
+            # reader: with lazy opening, a proc file none of whose
+            # shards intersect our regions is never even opened.
+            if shape:
+                inter = _intersect_bounds(rb, sb)
+                if inter is None or inter in seen_inter:
+                    continue
+            reader = readers.get(pid)
+            if reader is None or key not in reader:
+                continue
+            if not shape:
+                hits.append((reader, key, (), ()))
+                continue
+            seen_inter.add(inter)
+            hits.append((reader, key, inter, sb))
+        if not hits:
+            return None
+        out = np.empty(shape, dtype=dtype)
+        if not shape:
+            reader, key, _, _ = hits[0]
+            reader.read_slice_into(key, (), out, verify=True)
+            regions[rb] = out
+            continue
+        # Coverage proof without the O(region) bool mask when possible:
+        # disjoint intersections whose volumes sum to the region volume
+        # tile it exactly (the normal sharded-save layout). The mask is
+        # only materialized for overlapping shards (replicas straddling
+        # a region boundary).
+        exact = _tiles_exactly(rb, [h[2] for h in hits])
+        covered = None if exact else np.zeros(shape, dtype=bool)
+        for reader, key, inter, sb in hits:
+            src = tuple(
+                slice(lo - s0, hi - s0)
+                for (lo, hi), (s0, _) in zip(inter, sb)
+            )
+            dst = tuple(
+                slice(lo - r0, hi - r0)
+                for (lo, hi), (r0, _) in zip(inter, rb)
+            )
+            # Full-shard reads checksum the copied bytes (the format's
+            # bitflip guarantee); sub-range reads can't without reading
+            # the whole shard, which would defeat partial restore.
+            reader.read_slice_into(
+                key, src, out[dst], verify=(inter == sb)
+            )
+            if covered is not None:
+                covered[dst] = True
+        if covered is not None and not covered.all():
+            return None
+        regions[rb] = out
+    return regions
+
+
+def load_global_state(
+    checkpoint_dir: str,
+    step: int,
+    metas: Dict[int, dict],
+    sharding_tree=None,
+):
+    """Assemble the state for ``step`` from the per-process shard files.
+
+    Without ``sharding_tree``: full global numpy leaves (every byte is
+    read), leaf reads fanned out over a thread pool.
+
+    With ``sharding_tree`` (matching pytree of ``jax.sharding.Sharding``):
+    sharding-aware partial restore — each leaf's addressable index set is
+    computed from its sharding, ONLY the intersecting byte ranges are
+    read from the mmap'd shard files, and leaves come back as jax Arrays
+    built with ``jax.make_array_from_callback``. Host RAM is O(local
+    bytes), and completed leaves stream into device transfer while later
+    leaves are still on disk (pipelined restore).
+    """
     import jax
 
     from dlrover_tpu.common.serialize import loads_pytree
-    from dlrover_tpu.flash_ckpt.shm_handler import _np_dtype
+    from dlrover_tpu.flash_ckpt.raw_format import ShardCorruptionError
 
     first = metas[min(metas)]
     treedef = loads_pytree(first["treedef"])
-    num_leaves = len(first["leaves"])
-    leaves = [None] * num_leaves
     user_meta = first.get("user_meta", {})
-    for pid, meta in sorted(metas.items()):
-        arrays = ckpt_storage.load_proc_arrays(checkpoint_dir, step, pid)
-        if arrays is None:
-            continue
-        for leaf_meta in meta["leaves"]:
-            i = leaf_meta.leaf_id
-            dtype = _np_dtype(leaf_meta.dtype)
-            if leaves[i] is None:
-                leaves[i] = np.zeros(leaf_meta.global_shape, dtype=dtype)
-            for j, shard in enumerate(leaf_meta.shards):
-                key = f"leaf{i}_shard{j}"
-                if key in arrays:
-                    slices = bounds_to_slices(shard.index)
-                    leaves[i][slices] = arrays[key]
+    leaf_info, locations = _index_shard_locations(metas)
+    num_leaves = len(leaf_info)
+
+    shardings = None
+    if sharding_tree is not None:
+        try:
+            shardings = treedef.flatten_up_to(sharding_tree)
+        except ValueError as e:
+            logger.warning(
+                "sharding_tree does not match the checkpoint's structure "
+                "(%s); falling back to full-state restore", e
+            )
+
+    readers = _LazyReaders(checkpoint_dir, step, metas)
+    try:
+
+        def region_bounds_for(i):
+            gshape = leaf_info[i][0]
+            sharding = shardings[i] if shardings is not None else None
+            if sharding is None:
+                return [tuple((0, d) for d in gshape)]  # full leaf
+            return _needed_region_bounds(sharding, gshape)
+
+        leaves = [None] * num_leaves
+        from concurrent.futures import ThreadPoolExecutor, as_completed
+
+        # Pipelined restore: the pool only READS (host region buffers);
+        # jax-array construction runs here on the caller's thread as
+        # each leaf's bytes land, so H2D transfer of early leaves
+        # overlaps disk reads of later ones.
+        with ThreadPoolExecutor(
+            max_workers=ckpt_storage.io_threads(max(num_leaves, 1)),
+            thread_name_prefix="ckpt-restore",
+        ) as pool:
+            futures = {
+                pool.submit(
+                    _assemble_leaf_regions,
+                    leaf_info[i],
+                    locations[i],
+                    readers,
+                    region_bounds_for(i),
+                ): i
+                for i in range(num_leaves)
+                if leaf_info[i] is not None
+            }
+            for fut in as_completed(futures):
+                i = futures[fut]
+                regions = fut.result()
+                if regions is None:
+                    continue
+                gshape = leaf_info[i][0]
+                sharding = (
+                    shardings[i] if shardings is not None else None
+                )
+                if sharding is None:
+                    leaves[i] = regions[tuple((0, d) for d in gshape)]
+                    continue
+
+                def cb(idx, _regions=regions, _gshape=gshape):
+                    return _regions[_norm_index(idx, _gshape)]
+
+                leaves[i] = jax.make_array_from_callback(
+                    gshape, sharding, cb
+                )
+    except ShardCorruptionError as e:
+        logger.error(
+            "refusing corrupt checkpoint step %d: %s", step, e
+        )
+        return None
+    finally:
+        readers.close_all()
     if any(l is None for l in leaves):
         return None
     state = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -597,8 +947,23 @@ def to_device_state(np_state, sharding_tree=None):
     the per-leaf ``make_array_from_callback`` path is the fallback for
     runtimes that reject global host arrays under non-addressable
     shardings.
+
+    Leaves that are ALREADY placed jax Arrays under their requested
+    sharding (the partial-restore path returns these) pass through
+    untouched — re-putting them would be a no-op at best.
     """
     import jax
+
+    leaves = jax.tree_util.tree_leaves(np_state)
+    if leaves and all(isinstance(l, jax.Array) for l in leaves):
+        if sharding_tree is None:
+            return np_state
+        placed = jax.tree_util.tree_leaves(sharding_tree)
+        if len(placed) == len(leaves) and all(
+            getattr(l, "sharding", None) == s
+            for l, s in zip(leaves, placed)
+        ):
+            return np_state
 
     if sharding_tree is None:
         return jax.tree_util.tree_map(jax.numpy.asarray, np_state)

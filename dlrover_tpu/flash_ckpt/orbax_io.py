@@ -4,8 +4,9 @@ ecosystem's standard layout.
 Parity intent: the reference's savers deliberately write framework-
 native formats so checkpoints interop with the surrounding ecosystem
 (elastic_agent/torch/ckpt_saver.py:1341-1450 writes real torch/
-DeepSpeed/Megatron layouts). The flash engine's own format (npz +
-restricted-pickle meta, flash_ckpt/storage.py) is optimized for the
+DeepSpeed/Megatron layouts). The flash engine's own format (raw
+mmap-able shards + restricted-pickle meta, flash_ckpt/raw_format.py;
+legacy npz step dirs stay readable) is optimized for the
 shm fast path and self-restore; this module bridges it to orbax
 (tensorstore) so anything in the JAX world — orbax restore in another
 trainer, model surgery tools, eval harnesses — can consume or produce

@@ -29,10 +29,7 @@ from typing import Dict, List, Optional, Tuple
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.flash_ckpt.engine import shm_segment_name
-from dlrover_tpu.flash_ckpt.shm_handler import (
-    MAGIC,
-    SharedMemoryHandler,
-)
+from dlrover_tpu.flash_ckpt.shm_handler import SharedMemoryHandler
 
 _ADDR_KEY = "ckpt-replica-addr/{rank}"
 REPLICA_TOKEN_KEY = CheckpointConstant.REPLICA_TOKEN_KEY
@@ -108,7 +105,10 @@ def restore_segment(name: str, payload: bytes):
     buf = handler._shm.buf  # noqa: SLF001
     buf[:8] = b"\x00" * 8
     buf[8 : len(payload)] = payload[8:]
-    buf[:8] = MAGIC
+    # Commit with the PAYLOAD's magic, not this build's: a snapshot from
+    # an older layout version must keep its own version stamp or the
+    # reader would parse v1 offsets with v2 rules.
+    buf[:8] = payload[:8]
     handler.close()
 
 

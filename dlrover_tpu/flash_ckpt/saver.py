@@ -46,6 +46,12 @@ def read_shm_payload(local_rank: int, lock=None):
     Data is COPIED out while holding ``lock`` (the same SharedLock the
     worker's engine takes while writing), so a concurrent next-step save
     cannot tear the payload; the lock is released before any disk IO.
+
+    Each shard is copied EXACTLY ONCE (shm view -> contiguous host
+    array); the raw persist path then streams those bytes straight to
+    disk, so the agent holds 1x the node's state in RAM — the old
+    ``np.savez`` path copied every shard a second time into its zip
+    container, peaking at 2x.
     """
     import numpy as np
 
@@ -71,6 +77,7 @@ def read_shm_payload(local_rank: int, lock=None):
                     buffer=buf,
                     offset=data_start + shard.offset,
                 )
+                # the single copy out of shm (C-contiguous by layout)
                 arrays[f"leaf{leaf_meta.leaf_id}_shard{j}"] = np.array(view)
         step = meta["step"]
         payload = {

@@ -10,10 +10,15 @@ DeepSpeed "universal checkpoint" conversion for this; here it is free).
 
 Layout (self-contained, parseable by any process that attaches):
 
-    [8B magic][8B meta_len][pickled meta][padding][leaf shard data...]
+    [8B magic "DLRTPUC2"][8B meta_len][8B step][pickled meta][padding]
+    [leaf shard data...]
 
 Meta: {"step", "user_meta", "treedef" (pickled pytree structure),
-"leaves": [LeafMeta], "data_start"}.
+"leaves": [LeafMeta], "data_start"}. The step is duplicated in the
+fixed header so :meth:`SharedMemoryHandler.get_step` — polled at 20Hz
+per sibling by the engine's persist barrier — is a 24-byte read, not a
+full meta unpickle. v1 segments ("DLRTPUC1", no step field) are still
+readable.
 """
 
 import pickle
@@ -27,7 +32,9 @@ import numpy as np
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.serialize import loads_pytree
 
-MAGIC = b"DLRTPUC1"
+MAGIC = b"DLRTPUC2"
+MAGIC_V1 = b"DLRTPUC1"  # pre-step-field layout: meta starts at byte 16
+_HDR = 24  # magic + meta_len + step
 _ALIGN = 128
 
 
@@ -225,7 +232,7 @@ class SharedMemoryHandler:
         meta_payload = pickle.dumps(meta_obj)
         # Reserve generous meta space so minor growth doesn't re-layout.
         meta_space = (len(meta_payload) + 4096 + _ALIGN - 1) // _ALIGN * _ALIGN
-        data_start = 16 + meta_space
+        data_start = _HDR + meta_space
         total = data_start + data_bytes
 
         with self._lock:
@@ -236,7 +243,11 @@ class SharedMemoryHandler:
             meta_obj["data_start"] = data_start
             meta_payload = pickle.dumps(meta_obj)
             buf[8:16] = len(meta_payload).to_bytes(8, "big")
-            buf[16 : 16 + len(meta_payload)] = meta_payload
+            # Step in the fixed header: get_step() must not unpickle.
+            buf[16:_HDR] = int(step).to_bytes(
+                8, "big", signed=True
+            )
+            buf[_HDR : _HDR + len(meta_payload)] = meta_payload
             for meta, arrays in zip(leaf_metas, leaf_arrays):
                 for shard_meta, arr in zip(meta.shards, arrays):
                     start = data_start + shard_meta.offset
@@ -256,12 +267,17 @@ class SharedMemoryHandler:
         if not self.attach():
             return None
         buf = self._shm.buf
-        if bytes(buf[:8]) != MAGIC:
+        magic = bytes(buf[:8])
+        if magic == MAGIC:
+            meta_at = _HDR
+        elif magic == MAGIC_V1:
+            meta_at = 16  # image from a pre-step-field build
+        else:
             return None
         meta_len = int.from_bytes(bytes(buf[8:16]), "big")
         # Restricted unpickle: shm bytes can arrive over the replica
         # service, so metadata must never be a code-execution vector.
-        return loads_pytree(bytes(buf[16 : 16 + meta_len]))
+        return loads_pytree(bytes(buf[meta_at : meta_at + meta_len]))
 
     def load_state_dict(self) -> Optional[Tuple[int, Any, dict]]:
         """Return (step, pytree-of-numpy, user_meta); leaves are copies.
@@ -308,8 +324,19 @@ class SharedMemoryHandler:
         return meta["step"], state, meta.get("user_meta", {})
 
     def get_step(self) -> int:
-        meta = self.load_meta()
-        return -1 if meta is None else meta["step"]
+        """Step of the current image, or -1. Fast path: a 24-byte header
+        read — this is polled by persist barriers, so it must not pay a
+        full meta unpickle per call."""
+        if not self.attach():
+            return -1
+        buf = self._shm.buf
+        magic = bytes(buf[:8])
+        if magic == MAGIC:
+            return int.from_bytes(bytes(buf[16:_HDR]), "big", signed=True)
+        if magic == MAGIC_V1:
+            meta = self.load_meta()
+            return -1 if meta is None else meta["step"]
+        return -1
 
     # ---- cleanup -----------------------------------------------------------
 

@@ -7,22 +7,64 @@ PosixDiskStorage) and the commit protocol of ckpt_saver.py:914-1078
 Layout under ``checkpoint_dir``:
 
     checkpoint-<step>/
-        proc-<process_id>.npz     # leaf shards written by that process
-        proc-<process_id>.meta    # pickled shard metadata
+        proc-<process_id>.raw     # v1 raw shard file (see raw_format.py)
+        proc-<process_id>.meta    # pickled shard metadata (treedef etc.)
         .done/node-<rank>.done    # per-node completion markers
     latest_checkpointed_iteration.txt   # tracker, atomically replaced
+
+Read compat: step dirs written before the raw format carry
+``proc-<pid>.npz`` instead; :func:`open_proc_shards` transparently falls
+back to a zip-backed reader for those, so old checkpoints stay
+restorable (docs/DESIGN.md §23).
 """
 
+import contextlib
 import os
 import pickle
 import shutil
 from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.env_utils import get_env_int
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.flash_ckpt.raw_format import (
+    RAW_SUFFIX,
+    RawShardReader,
+    ShardCorruptionError,
+    write_raw_shards,
+)
+
+RAW_FORMAT = "raw"
+NPZ_FORMAT = "npz"
+
+
+def io_threads(n_tasks: int) -> int:
+    """Thread-pool width for checkpoint file I/O. Disk writes/reads are
+    GIL-releasing and spend much of their time stalled on page faults /
+    device queues, so 2x-cpu oversubscription (capped at 8) measures
+    fastest even on small hosts; DLROVER_TPU_CKPT_IO_THREADS overrides."""
+    configured = get_env_int("DLROVER_TPU_CKPT_IO_THREADS", 0)
+    if configured > 0:
+        return max(1, min(configured, n_tasks))
+    return max(1, min(n_tasks, 2 * (os.cpu_count() or 2), 8))
+
+
+def fsync_dir(path: str):
+    """fsync a directory so renames into it survive a crash."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class CheckpointStorage(ABC):
@@ -113,6 +155,9 @@ def write_tracker(checkpoint_dir: str, step: int):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, tracker_path(checkpoint_dir))
+        # The rename is the commit point: make it durable, not just the
+        # file contents (a crash could otherwise roll the tracker back).
+        fsync_dir(checkpoint_dir)
     except OSError:
         # Unique names never self-overwrite: reclaim the orphan.
         try:
@@ -122,38 +167,89 @@ def write_tracker(checkpoint_dir: str, step: int):
         raise
 
 
-def persist_node_shards(
-    checkpoint_dir: str,
-    step: int,
-    node_rank: int,
-    proc_payloads: Dict[int, dict],
-):
-    """Write one node's processes' shard files + its done marker.
-
-    proc_payloads: process_id -> {"arrays": {name: np.ndarray},
-    "meta": picklable}.
-    """
-    sdir = step_dir(checkpoint_dir, step)
-    os.makedirs(sdir, exist_ok=True)
-    for process_id, payload in proc_payloads.items():
+def _persist_one_proc(sdir: str, step: int, process_id: int, payload: dict,
+                      fmt: str):
+    """Write one process's shard + meta files (tmp + rename, one fsync
+    per file). Runs on a persist-pool thread."""
+    if fmt == NPZ_FORMAT:
+        # Legacy writer: kept for the A/B bench and compat tests only.
         npz_tmp = os.path.join(sdir, f".proc-{process_id}.npz.tmp")
         with open(npz_tmp, "wb") as f:
             np.savez(f, **payload["arrays"])
             f.flush()
             os.fsync(f.fileno())
         os.replace(npz_tmp, os.path.join(sdir, f"proc-{process_id}.npz"))
-        meta_tmp = os.path.join(sdir, f".proc-{process_id}.meta.tmp")
-        with open(meta_tmp, "wb") as f:
-            pickle.dump(payload["meta"], f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(meta_tmp, os.path.join(sdir, f"proc-{process_id}.meta"))
+    else:
+        raw_tmp = os.path.join(sdir, f".proc-{process_id}{RAW_SUFFIX}.tmp")
+        bounds = payload.get("shard_bounds") or _bounds_from_meta(
+            payload.get("meta")
+        )
+        write_raw_shards(
+            raw_tmp, step, process_id, payload["arrays"], bounds
+        )
+        os.replace(
+            raw_tmp, os.path.join(sdir, f"proc-{process_id}{RAW_SUFFIX}")
+        )
+    meta_tmp = os.path.join(sdir, f".proc-{process_id}.meta.tmp")
+    with open(meta_tmp, "wb") as f:
+        pickle.dump(payload["meta"], f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(meta_tmp, os.path.join(sdir, f"proc-{process_id}.meta"))
+
+
+def _bounds_from_meta(meta) -> Dict[str, tuple]:
+    """shard key -> global slice bounds, from the pickled LeafMeta list
+    (so the raw header's JSON index is self-describing)."""
+    bounds: Dict[str, tuple] = {}
+    if not isinstance(meta, dict):
+        return bounds
+    for leaf_meta in meta.get("leaves", []):
+        for j, shard in enumerate(leaf_meta.shards):
+            bounds[f"leaf{leaf_meta.leaf_id}_shard{j}"] = shard.index
+    return bounds
+
+
+def persist_node_shards(
+    checkpoint_dir: str,
+    step: int,
+    node_rank: int,
+    proc_payloads: Dict[int, dict],
+    fmt: str = RAW_FORMAT,
+):
+    """Write one node's processes' shard files + its done marker.
+
+    proc_payloads: process_id -> {"arrays": {name: np.ndarray},
+    "meta": picklable}. Proc files fan out over a thread pool (the
+    writes are GIL-releasing I/O); each file is fsynced once, and the
+    step dir is fsynced after the renames so the commit protocol's
+    done-marker implies durable shard files.
+    """
+    sdir = step_dir(checkpoint_dir, step)
+    os.makedirs(sdir, exist_ok=True)
+    if proc_payloads:
+        with ThreadPoolExecutor(
+            max_workers=io_threads(len(proc_payloads)),
+            thread_name_prefix="ckpt-persist",
+        ) as pool:
+            futures = [
+                pool.submit(
+                    _persist_one_proc, sdir, step, pid, payload, fmt
+                )
+                for pid, payload in proc_payloads.items()
+            ]
+            for fut in futures:
+                fut.result()  # surface the first failure
+    fsync_dir(sdir)
     done_dir = os.path.join(sdir, CheckpointConstant.DONE_DIR)
     os.makedirs(done_dir, exist_ok=True)
     done_tmp = os.path.join(done_dir, f".node-{node_rank}.tmp")
     with open(done_tmp, "w") as f:
         f.write("1")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(done_tmp, os.path.join(done_dir, f"node-{node_rank}.done"))
+    fsync_dir(done_dir)
 
 
 def nodes_done(checkpoint_dir: str, step: int) -> List[int]:
@@ -188,11 +284,107 @@ def load_step_meta(checkpoint_dir: str, step: int) -> Dict[int, dict]:
     return metas
 
 
+class NpzShardReader:
+    """Read-compat adapter over a legacy ``proc-<pid>.npz`` step file,
+    presenting the same surface as :class:`RawShardReader`. The zip
+    container has no checksums and no sub-range reads: ``read_slice``
+    inflates the full shard and slices (correct, just not partial-I/O).
+    """
+
+    step = -1  # the zip carries no step stamp; the dir name does
+    process_id = -1
+
+    def __init__(self, path: str):
+        import threading
+
+        self.path = path
+        self._npz = np.load(path, allow_pickle=False)
+        # NpzFile shares one zip file handle; concurrent reads from the
+        # restore pool would interleave seeks.
+        self._read_lock = threading.Lock()
+        # Partial restore makes one read PER INTERSECTING REGION; the
+        # zip can only inflate whole members, so cache each inflated
+        # member or an N-region leaf costs N full decompressions (all
+        # serialized under the lock). Dropped on close.
+        self._cache: dict = {}
+        self.bytes_read = 0
+
+    def keys(self):
+        return self._npz.files
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._npz.files
+
+    def _member(self, key: str) -> np.ndarray:
+        with self._read_lock:
+            arr = self._cache.get(key)
+            if arr is None:
+                arr = self._npz[key]  # zipfile crc-checks the inflate
+                self._cache[key] = arr
+            return arr
+
+    def get(self, key: str, verify: bool = True) -> np.ndarray:
+        arr = self._member(key)
+        self.bytes_read += arr.nbytes
+        return arr
+
+    def read_slice(self, key: str, slices) -> np.ndarray:
+        out = np.ascontiguousarray(self._member(key)[slices])
+        self.bytes_read += out.nbytes
+        return out
+
+    def read_slice_into(self, key: str, slices, dest: np.ndarray,
+                        verify: bool = False):
+        # ``verify`` is moot here: zipfile already crc-checks every
+        # member as it inflates.
+        src = self._member(key)
+        if slices:
+            src = src[slices]
+        np.copyto(dest, src)
+        self.bytes_read += dest.nbytes
+
+    def close(self):
+        self._cache.clear()
+        self._npz.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def open_proc_shards(checkpoint_dir: str, step: int, process_id: int):
+    """Open one process's shard file for ``step``; None if absent.
+
+    Prefers the raw v1 format; falls back to the legacy ``.npz`` layout.
+    The returned reader owns a file handle / mmap — close it (it is a
+    context manager) or the mapping lives until GC.
+    """
+    base = os.path.join(step_dir(checkpoint_dir, step), f"proc-{process_id}")
+    raw_path = base + RAW_SUFFIX
+    if os.path.exists(raw_path):
+        return RawShardReader(raw_path)
+    npz_path = base + ".npz"
+    if os.path.exists(npz_path):
+        return NpzShardReader(npz_path)
+    return None
+
+
+@contextlib.contextmanager
 def load_proc_arrays(checkpoint_dir: str, step: int, process_id: int):
-    path = os.path.join(step_dir(checkpoint_dir, step), f"proc-{process_id}.npz")
-    if not os.path.exists(path):
-        return None
-    return np.load(path, allow_pickle=False)
+    """Context-managed access to one process's shard arrays (or None).
+
+    Replaces the old leaky variant that returned a bare ``NpzFile``
+    nobody closed; the handle/mmap is now released deterministically on
+    exit.
+    """
+    reader = open_proc_shards(checkpoint_dir, step, process_id)
+    try:
+        yield reader
+    finally:
+        if reader is not None:
+            reader.close()
 
 
 def list_step_dirs(checkpoint_dir: str) -> List[int]:
@@ -245,7 +437,12 @@ class KeepLatestDeletionStrategy:
     def clean_up(self, checkpoint_dir: str):
         steps = list_step_dirs(checkpoint_dir)
         committed = read_tracker(checkpoint_dir)
-        victims = [s for s in steps if s != committed][: -self.max_to_keep]
+        victims = [s for s in steps if s != committed]
+        # lst[:-0] is the WHOLE list: max_to_keep=0 must mean "keep only
+        # the committed step", not "keep everything" (same guard
+        # KeepStepIntervalDeletionStrategy carries).
+        if self.max_to_keep > 0:
+            victims = victims[: -self.max_to_keep]
         for s in victims:
             if s == committed:
                 continue

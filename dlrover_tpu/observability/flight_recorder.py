@@ -104,6 +104,15 @@ class FlightRecorder:
         self._step_hist.observe(record["step_time_s"])
         self._steps_total.inc()
 
+    def annotate(self, event: str, **fields):
+        """Append a non-step event record (checkpoint restore, re-mesh,
+        ...) to the ring. It rides the same crash dump / timeline merge
+        as step records but touches no step metrics."""
+        record = {"event": str(event), "ts": time.time()}
+        record.update(fields)
+        with self._lock:
+            self._ring.append(record)
+
     # ---- snapshots / dumps -------------------------------------------------
 
     def snapshot(self, last_n: Optional[int] = None) -> Dict:

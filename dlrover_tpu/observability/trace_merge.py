@@ -226,9 +226,12 @@ def flight_to_trace(dump: Dict, rank: int) -> List[Dict]:
         end_us = float(rec.get("ts", 0.0)) * 1e6
         dur_us = max(float(rec.get("step_time_s", 0.0)), 0.0) * 1e6
         start_us = end_us - dur_us
+        # Event annotations (FlightRecorder.annotate: restore, re-mesh)
+        # share the ring with step records; name them by their event.
+        name = rec.get("event") or f"step {rec.get('step', '?')}"
         out.append(
             {
-                "name": f"step {rec.get('step', '?')}",
+                "name": name,
                 "ph": "X",
                 "ts": start_us,
                 "dur": dur_us,
@@ -241,6 +244,8 @@ def flight_to_trace(dump: Dict, rank: int) -> List[Dict]:
                         "data_wait_s",
                         "ckpt_block_s",
                         "rdzv_round",
+                        "seconds",
+                        "mb_per_s",
                     )
                     if k in rec
                 },

@@ -126,3 +126,51 @@ class ElasticTrainer:
     def epoch_of(self, dataset_size: int) -> int:
         consumed = self.global_step * self.batch_config.global_batch_size
         return consumed // max(dataset_size, 1)
+
+    # ---- restore -------------------------------------------------------------
+
+    def restore_checkpoint(self, checkpointer, sharding_tree=None,
+                           step=None):
+        """Restore the newest (or ``step``) checkpoint through the
+        sharding-aware partial path and adopt its step counter.
+
+        With ``sharding_tree`` (a pytree of the CURRENT mesh's
+        shardings) the storage restore reads only this process's
+        addressable byte ranges from the mmap'd shard files — after an
+        elastic re-mesh each surviving host pays O(its own bytes), not
+        O(global state). Returns (state, user_meta) or None; on success
+        ``self.global_step`` tracks the restored step and the restore
+        bandwidth lands in the flight recorder's step ring.
+        """
+        t0 = time.time()
+        result = checkpointer.load_checkpoint(
+            step=step, sharding_tree=sharding_tree
+        )
+        if result is None:
+            logger.info("no restorable checkpoint; starting fresh")
+            return None
+        restored_step, state, user_meta = result
+        elapsed = max(time.time() - t0, 1e-9)
+        self.global_step = int(restored_step)
+        if self._flight_recorder is not None:
+            try:
+                # Local (addressable) bytes, not global nbytes: after a
+                # partial restore on an N-host mesh, global/elapsed
+                # would overstate disk bandwidth ~N-fold.
+                from dlrover_tpu.flash_ckpt.engine import (
+                    _state_local_nbytes,
+                )
+
+                nbytes = _state_local_nbytes(state)
+                self._flight_recorder.annotate(
+                    "ckpt_restore",
+                    step=self.global_step,
+                    seconds=round(elapsed, 4),
+                    mb_per_s=round(nbytes / 1e6 / elapsed, 1),
+                )
+            except Exception:
+                pass
+        logger.info(
+            "restored checkpoint step %d in %.2fs", restored_step, elapsed
+        )
+        return state, user_meta
