@@ -1283,6 +1283,21 @@ def ckpt_io_phase():
     return {f"ckpt_io_{k}": v for k, v in r.items()}
 
 
+def data_pipe_phase():
+    """Pipelined vs synchronous shard data path (prefetch + batched
+    control RPCs + ring-buffer assembly) against an in-process master
+    with simulated RPC latency (tools/bench_data_pipeline.py). Pure
+    host/CPU work — runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_data_pipeline
+
+    r = bench_data_pipeline.run_bench()
+    return {f"data_pipe_{k}": v for k, v in r.items()}
+
+
 def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
@@ -1391,6 +1406,8 @@ _KEEP_KEYS = {
     "longctx_remat_64k", "ckpt_save_block_s",
     "ckpt_io_restore_raw_mb_per_s", "ckpt_io_restore_speedup_vs_npz",
     "ckpt_io_persist_raw_mb_per_s",
+    "data_pipe_speedup", "data_pipe_rpc_reduction",
+    "data_pipe_records_per_s", "data_pipe_fetch_wait_frac",
     "prev_round_diff",
 }
 
@@ -1404,6 +1421,8 @@ _DROP_ORDER = (
     r"^decode_(prompt_len|new_tokens|batch)",
     r"^profiler_capture",
     r"_error$|_timeout$",
+    r"^data_pipe_(records$|shard_size|batch_size|rpc_latency|step_ms"
+    r"|sync_|rpcs$)",
     r"^(ckpt_|raw_run_goodput|replay_s$|step_time_s|tokens_per_s)",
     r"^e2e_(detect|runtime|replay|replayed|autotuned|effective"
     r"|goodput_at|restore_s$|succeeded)",
@@ -1564,6 +1583,9 @@ def main():
         # Disk-path bandwidth scoreboard (raw mmap format vs npz); pure
         # host I/O, so it runs on every platform.
         run_phase(result, "ckpt_io", ckpt_io_phase, est_s=60, cap_s=240)
+        # Shard-pipeline scoreboard (prefetch/batching vs sync path);
+        # pure host work, every platform.
+        run_phase(result, "data_pipe", data_pipe_phase, est_s=30, cap_s=120)
     if platform != "cpu" and not fast:
         # Information-value order (VERDICT r4 #1c): headline compute +
         # CE + decode + longctx before the long tail.

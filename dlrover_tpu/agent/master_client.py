@@ -303,6 +303,30 @@ class MasterClient:
         )
 
     @retry_rpc
+    def get_tasks(self, dataset_name: str, count: int = 1):
+        """Batched lease fetch: (tasks, wait). ``wait`` means peers hold
+        the remaining shards in flight — poll again later. Falls back to
+        a single :meth:`get_task` against masters that predate the
+        batched verb (their servicer answers with a failed
+        BaseResponse, not a MultiTaskResponse)."""
+        resp = self._get(
+            comm.MultiTaskRequest(
+                dataset_name=dataset_name,
+                node_id=self._node_id,
+                count=count,
+            )
+        )
+        tasks = getattr(resp, "tasks", None)
+        if tasks is None:
+            task = self.get_task(dataset_name)
+            if task.task_id < 0:
+                from dlrover_tpu.common.constants import TaskType
+
+                return [], task.task_type == TaskType.WAIT
+            return [task], False
+        return tasks, bool(getattr(resp, "wait", False))
+
+    @retry_rpc
     def report_task_done(
         self, dataset_name: str, task_id: int, success: bool = True
     ):
@@ -314,6 +338,30 @@ class MasterClient:
                 success=success,
             )
         )
+
+    @retry_rpc
+    def report_tasks_done_batch(
+        self,
+        dataset_name: str,
+        done_ids: List[int],
+        failed_ids: Optional[List[int]] = None,
+    ):
+        resp = self._report(
+            comm.TaskDoneBatchReport(
+                dataset_name=dataset_name,
+                node_id=self._node_id,
+                done_ids=list(done_ids),
+                failed_ids=list(failed_ids or []),
+            )
+        )
+        if not resp.success:
+            # Master predates the batched verb: replay serially so no
+            # done-report is silently dropped.
+            for tid in done_ids:
+                self.report_task_done(dataset_name, tid, True)
+            for tid in failed_ids or []:
+                self.report_task_done(dataset_name, tid, False)
+        return resp
 
     @retry_rpc
     def get_shard_checkpoint(self, dataset_name: str) -> str:

@@ -358,6 +358,40 @@ class TaskDoneReport(BaseRequest):
 
 
 @dataclass
+class MultiTaskRequest(BaseRequest):
+    """Batched lease request: up to ``count`` shards in one round trip.
+
+    The prefetcher's verb — a worker keeping N shards in flight pays one
+    RPC per batch instead of one per shard boundary."""
+
+    dataset_name: str = ""
+    node_id: int = 0
+    count: int = 1
+
+
+@dataclass
+class MultiTaskResponse(BaseResponse):
+    """``tasks`` holds real shard leases only. An empty list with
+    ``wait=True`` means peers hold the remaining shards in flight (the
+    single-task WAIT sentinel, batched); empty with ``wait=False`` means
+    the dataset is exhausted."""
+
+    tasks: List["ShardTask"] = field(default_factory=list)
+    wait: bool = False
+
+
+@dataclass
+class TaskDoneBatchReport(BaseRequest):
+    """Coalesced done-reports: every shard id in ``done_ids`` completed
+    successfully, every id in ``failed_ids`` must be re-queued."""
+
+    dataset_name: str = ""
+    node_id: int = 0
+    done_ids: List[int] = field(default_factory=list)
+    failed_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
 class ShardCheckpointRequest(BaseRequest):
     dataset_name: str = ""
 

@@ -11,7 +11,11 @@ from typing import Dict, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.comm import Message
-from dlrover_tpu.common.constants import PreCheckStatus, RendezvousName
+from dlrover_tpu.common.constants import (
+    PreCheckStatus,
+    RendezvousName,
+    TaskType,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.elastic_training.elastic_ps import ClusterVersionService
 from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
@@ -77,6 +81,7 @@ class MasterServicer(MasterService):
             comm.KVStoreAddRequest: self._kv_add,
             comm.SyncQueryRequest: self._sync_query,
             comm.TaskRequest: self._get_task,
+            comm.MultiTaskRequest: self._get_tasks,
             comm.ShardCheckpointRequest: self._get_shard_checkpoint,
             comm.CkptLatestStepRequest: self._get_ckpt_latest_step,
             comm.PreCheckRequest: self._get_pre_check_result,
@@ -101,6 +106,7 @@ class MasterServicer(MasterService):
             comm.SyncFinishRequest: self._sync_finish,
             comm.DatasetShardParams: self._report_dataset_params,
             comm.TaskDoneReport: self._report_task_done,
+            comm.TaskDoneBatchReport: self._report_tasks_done_batch,
             comm.ShardCheckpointRestoreRequest: self._restore_shard_checkpoint,
             comm.CkptStepReport: self._report_ckpt_step,
             comm.DiagnosisDataReport: self._report_diagnosis_data,
@@ -319,10 +325,29 @@ class MasterServicer(MasterService):
             return comm.ShardTask()
         return self._task_manager.get_task(req.node_id, req.dataset_name)
 
+    def _get_tasks(self, msg, req: comm.MultiTaskRequest):
+        if self._task_manager is None:
+            return comm.MultiTaskResponse()
+        tasks = self._task_manager.get_tasks(
+            req.node_id, req.dataset_name, req.count
+        )
+        wait = bool(tasks) and tasks[0].task_type == TaskType.WAIT
+        return comm.MultiTaskResponse(
+            tasks=[] if wait else [t for t in tasks if t.task_id >= 0],
+            wait=wait,
+        )
+
     def _report_task_done(self, msg, req: comm.TaskDoneReport):
         if self._task_manager is not None:
             self._task_manager.report_task_done(
                 req.dataset_name, req.task_id, req.node_id, req.success
+            )
+        return comm.BaseResponse(True)
+
+    def _report_tasks_done_batch(self, msg, req: comm.TaskDoneBatchReport):
+        if self._task_manager is not None:
+            self._task_manager.report_tasks_done(
+                req.dataset_name, req.node_id, req.done_ids, req.failed_ids
             )
         return comm.BaseResponse(True)
 

@@ -14,8 +14,9 @@ either based on the dataset's storage_type).
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Deque, Dict, List
 
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import logger
@@ -23,7 +24,11 @@ from dlrover_tpu.master.shard.dataset_splitter import (
     Shard,
     StreamingDatasetSplitter,
 )
-from dlrover_tpu.master.shard.task_manager import Task, _DoingTask
+from dlrover_tpu.master.shard.task_manager import (
+    Task,
+    _DoingTask,
+    drain_tasks,
+)
 
 _MAX_TASK_RETRIES = 3
 
@@ -37,7 +42,7 @@ class StreamingDatasetManager:
     def __init__(self, task_type: str, splitter: StreamingDatasetSplitter):
         self._task_type = task_type
         self._splitter = splitter
-        self.todo: List[Task] = []
+        self.todo: Deque[Task] = deque()
         self.doing: Dict[int, _DoingTask] = {}
         self._task_id_seq = 0
         self._completed_count = 0
@@ -49,20 +54,28 @@ class StreamingDatasetManager:
 
     def get_task(self, node_id: int) -> Task:
         with self._lock:
-            if not self.todo and not self._splitter.epoch_finished():
-                # Carve the next window of shards from the stream.
-                for shard in self._splitter.create_shards():
-                    self.todo.append(
-                        Task(self._task_id_seq, self._task_type, shard)
-                    )
-                    self._task_id_seq += 1
-            if not self.todo:
-                if self.doing:
-                    return Task(-1, TaskType.WAIT, Shard("", 0, 0))
-                return Task.create_invalid_task()
-            task = self.todo.pop(0)
-            self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
-            return task
+            return self._get_task_locked(node_id)
+
+    def _get_task_locked(self, node_id: int) -> Task:
+        if not self.todo and not self._splitter.epoch_finished():
+            # Carve the next window of shards from the stream.
+            for shard in self._splitter.create_shards():
+                self.todo.append(
+                    Task(self._task_id_seq, self._task_type, shard)
+                )
+                self._task_id_seq += 1
+        if not self.todo:
+            if self.doing:
+                return Task(-1, TaskType.WAIT, Shard("", 0, 0))
+            return Task.create_invalid_task()
+        task = self.todo.popleft()
+        self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
+        return task
+
+    def get_tasks(self, node_id: int, count: int) -> List[Task]:
+        """Batched dispatch (sentinel contract in ``drain_tasks``)."""
+        with self._lock:
+            return drain_tasks(self._get_task_locked, node_id, count)
 
     # ---- completion & recovery --------------------------------------------
 
@@ -126,7 +139,7 @@ class StreamingDatasetManager:
             state.count,
             _MAX_TASK_RETRIES,
         )
-        self.todo.insert(0, task)
+        self.todo.appendleft(task)
 
     # ---- progress ----------------------------------------------------------
 

@@ -8,8 +8,9 @@ checkpoint/restore so a restarted job resumes exactly the unconsumed data.
 import json
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import TaskType
@@ -41,13 +42,32 @@ class _DoingTask:
     start_time: float
 
 
+def drain_tasks(get_one, node_id: int, count: int) -> List[Task]:
+    """THE batched-dispatch sentinel contract, in one place: call
+    ``get_one(node_id)`` up to ``count`` times collecting real leases;
+    a WAIT/invalid task (negative id) stops the drain and is returned
+    alone only when nothing real was collected."""
+    out: List[Task] = []
+    for _ in range(max(count, 1)):
+        task = get_one(node_id)
+        if task.task_id < 0:
+            if not out:
+                out.append(task)
+            break
+        out.append(task)
+    return out
+
+
 class BatchDatasetManager:
     """Shard queue of one dataset (reference batch_dataset_manager.py:29)."""
 
     def __init__(self, task_type: str, splitter: DatasetSplitter):
         self._task_type = task_type
         self._splitter = splitter
-        self.todo: List[Task] = []
+        # deque, not list: dispatch pops the head and recovery re-queues
+        # at the head — O(1) both ways where list.pop(0)/insert(0, ...)
+        # were O(n) per task on large shard counts.
+        self.todo: Deque[Task] = deque()
         self.doing: Dict[int, _DoingTask] = {}
         self._task_id_seq = 0
         self._completed_count = 0
@@ -55,17 +75,28 @@ class BatchDatasetManager:
 
     def get_task(self, node_id: int) -> Task:
         with self._lock:
-            if not self.todo and not self._splitter.epoch_finished():
-                self._create_todo_tasks()
-            if not self.todo:
-                if self.doing:
-                    # Data remains in flight: tell the worker to wait, its
-                    # peers' shards may be re-queued on timeout/failure.
-                    return Task(-1, TaskType.WAIT, Shard("", 0, 0))
-                return Task.create_invalid_task()
-            task = self.todo.pop(0)
-            self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
-            return task
+            return self._get_task_locked(node_id)
+
+    def _get_task_locked(self, node_id: int) -> Task:
+        if not self.todo and not self._splitter.epoch_finished():
+            self._create_todo_tasks()
+        if not self.todo:
+            if self.doing:
+                # Data remains in flight: tell the worker to wait, its
+                # peers' shards may be re-queued on timeout/failure.
+                return Task(-1, TaskType.WAIT, Shard("", 0, 0))
+            return Task.create_invalid_task()
+        task = self.todo.popleft()
+        self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
+        return task
+
+    def get_tasks(self, node_id: int, count: int) -> List[Task]:
+        """Up to ``count`` leases in one call (the batched-dispatch verb,
+        sentinel contract in :func:`drain_tasks`). One lock hold for the
+        whole batch — a prefetching worker costs the dispatch path one
+        acquisition per batch, not per shard."""
+        with self._lock:
+            return drain_tasks(self._get_task_locked, node_id, count)
 
     def _create_todo_tasks(self):
         shards = self._splitter.create_shards()
@@ -91,7 +122,7 @@ class BatchDatasetManager:
                     task_id,
                     node_id,
                 )
-                self.todo.insert(0, doing.task)
+                self.todo.appendleft(doing.task)
                 return False
             self._completed_count += 1
             return True
@@ -111,16 +142,17 @@ class BatchDatasetManager:
                     tid,
                     doing.node_id,
                 )
-                self.todo.insert(0, doing.task)
+                self.todo.appendleft(doing.task)
 
     def recover_node_tasks(self, node_id: int):
-        """Re-queue all in-flight shards of a dead node."""
+        """Re-queue all in-flight shards of a dead node — including
+        leases the worker had prefetched but never consumed."""
         with self._lock:
             lost = [
                 tid for tid, d in self.doing.items() if d.node_id == node_id
             ]
             for tid in lost:
-                self.todo.insert(0, self.doing.pop(tid).task)
+                self.todo.appendleft(self.doing.pop(tid).task)
 
     def completed(self) -> bool:
         with self._lock:
@@ -181,6 +213,21 @@ class TaskManager:
         self._perf_monitor = perf_monitor
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        from dlrover_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        self._tasks_dispatched = reg.counter(
+            "shard_tasks_dispatched_total",
+            "shard leases handed to workers",
+        )
+        self._dispatch_rpcs = reg.counter(
+            "shard_dispatch_rpcs_total",
+            "get-task RPCs served (single or batched)",
+        )
+        self._tasks_recovered = reg.counter(
+            "shard_tasks_recovered_total",
+            "in-flight leases re-queued after timeout/failure/node loss",
+        )
 
     def start(self):
         if self._thread is None:
@@ -236,11 +283,8 @@ class TaskManager:
         with self._lock:
             return self._datasets.get(name)
 
-    def get_task(self, node_id: int, dataset_name: str) -> comm.ShardTask:
-        mgr = self.get_dataset(dataset_name)
-        if mgr is None:
-            return comm.ShardTask()
-        task = mgr.get_task(node_id)
+    @staticmethod
+    def _to_shard_task(task: Task, dataset_name: str) -> comm.ShardTask:
         return comm.ShardTask(
             task_id=task.task_id,
             task_type=task.task_type,
@@ -251,6 +295,37 @@ class TaskManager:
             record_indices=task.shard.record_indices,
             partition=task.shard.partition,
         )
+
+    def get_task(self, node_id: int, dataset_name: str) -> comm.ShardTask:
+        mgr = self.get_dataset(dataset_name)
+        if mgr is None:
+            return comm.ShardTask()
+        task = mgr.get_task(node_id)
+        self._dispatch_rpcs.inc()
+        if task.task_id >= 0:
+            self._tasks_dispatched.inc()
+        return self._to_shard_task(task, dataset_name)
+
+    def get_tasks(
+        self, node_id: int, dataset_name: str, count: int
+    ) -> List[comm.ShardTask]:
+        """Batched dispatch: up to ``count`` real leases, or a single
+        WAIT/invalid sentinel when none are available right now."""
+        mgr = self.get_dataset(dataset_name)
+        if mgr is None:
+            return [comm.ShardTask()]
+        getter = getattr(mgr, "get_tasks", None)
+        if getter is not None:
+            tasks = getter(node_id, count)
+        else:
+            # Duck-typed manager without the batched verb: same sentinel
+            # contract, one lock acquisition per task.
+            tasks = drain_tasks(mgr.get_task, node_id, count)
+        self._dispatch_rpcs.inc()
+        self._tasks_dispatched.inc(
+            sum(1 for t in tasks if t.task_id >= 0) or 0
+        )
+        return [self._to_shard_task(t, dataset_name) for t in tasks]
 
     def report_task_done(
         self,
@@ -263,11 +338,29 @@ class TaskManager:
         if mgr is not None:
             mgr.report_task_done(task_id, node_id, success)
 
+    def report_tasks_done(
+        self,
+        dataset_name: str,
+        node_id: int,
+        done_ids: List[int],
+        failed_ids: Optional[List[int]] = None,
+    ):
+        """Apply one coalesced done-report batch."""
+        mgr = self.get_dataset(dataset_name)
+        if mgr is None:
+            return
+        for tid in done_ids:
+            mgr.report_task_done(tid, node_id, True)
+        for tid in failed_ids or []:
+            mgr.report_task_done(tid, node_id, False)
+
     def recover_node_tasks(self, node_id: int):
         with self._lock:
             managers = list(self._datasets.values())
         for m in managers:
+            before = len(m.doing)
             m.recover_node_tasks(node_id)
+            self._tasks_recovered.inc(max(before - len(m.doing), 0))
 
     def finished(self) -> bool:
         with self._lock:

@@ -140,6 +140,10 @@ class GrpcMasterStub:
 
 class _HttpHandler(BaseHTTPRequestHandler):
     service: MasterService = None  # class attr injected by server factory
+    # HTTP/1.1: responses carry Content-Length (set below) and the
+    # connection stays open between requests — required for the stub's
+    # keep-alive to actually keep anything alive (1.0 closes per call).
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):  # quiet
         pass
@@ -185,23 +189,79 @@ class HttpMasterServer:
 
 
 class HttpMasterStub:
+    """Keep-alive client: one persistent TCP connection per calling
+    thread (http.client connections are not thread-safe, and the
+    prefetcher/heartbeat/training threads all share a stub), reconnecting
+    on error. The old connection-per-call behavior cost a TCP handshake
+    on every control RPC — measurable at the data path's per-shard
+    cadence."""
+
     def __init__(self, addr: str, timeout: float = 10.0):
         self._host, port = addr.rsplit(":", 1)
         self._port = int(port)
         self._timeout = timeout
+        self._local = threading.local()
+        self._closed = False
 
-    def _call(self, path: str, message: Message, timeout=None) -> Message:
+    def _connection(self, timeout=None):
+        """(conn, reused): reused tells the caller whether a failure may
+        be a stale keep-alive socket rather than a dead master."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            return conn, True
         conn = http.client.HTTPConnection(
             self._host, self._port, timeout=timeout or self._timeout
         )
-        try:
-            conn.request("POST", path, body=message.serialize())
-            resp = conn.getresponse()
-            if resp.status != 200:
-                raise RuntimeError(f"RPC {path} failed: HTTP {resp.status}")
-            return Message.deserialize(resp.read())
-        finally:
-            conn.close()
+        self._local.conn = conn
+        return conn, False
+
+    def _drop_connection(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    # A reused connection dying with one of these before any response
+    # bytes means the server idled the socket out before reading the
+    # request — it was never processed, so ONE transparent retry on a
+    # fresh connection preserves at-most-once semantics. Anything else
+    # (or the same failure on a fresh connection) propagates: mutations
+    # must not be transparently re-sent (mirrors the gRPC stub's
+    # enable_retries=0).
+    _STALE_ERRORS = (
+        http.client.RemoteDisconnected,
+        http.client.BadStatusLine,
+        BrokenPipeError,
+        ConnectionResetError,
+    )
+
+    def _call(self, path: str, message: Message, timeout=None) -> Message:
+        body = message.serialize()
+        for _ in (1, 2):
+            conn, reused = self._connection(timeout)
+            try:
+                conn.request("POST", path, body=body)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    raise RuntimeError(
+                        f"RPC {path} failed: HTTP {resp.status}"
+                    )
+                return Message.deserialize(resp.read())
+            except RuntimeError:
+                self._drop_connection()
+                raise
+            except self._STALE_ERRORS:
+                self._drop_connection()
+                if not reused or self._closed:
+                    raise
+            except Exception:
+                self._drop_connection()
+                raise
+        raise RuntimeError(f"RPC {path} failed after reconnect")
 
     def get(self, message: Message, timeout=None) -> Message:
         return self._call("/get", message, timeout)
@@ -220,7 +280,8 @@ class HttpMasterStub:
         return False
 
     def close(self):
-        pass
+        self._closed = True
+        self._drop_connection()
 
 
 def create_master_server(port: int, service: MasterService, kind: str = "grpc"):

@@ -1,16 +1,34 @@
-"""Elastic data loader: batches that keep the global batch fixed.
+"""Elastic data loaders: batches that keep the global batch fixed.
 
 Parity: reference trainer/torch/elastic/dataloader.py (ElasticDataLoader)
 — rebuilt around host-side numpy batching for JAX: the loader yields
 stacked numpy batches selected by an ElasticDistributedSampler (static
 split) or an IndexShardingClient (master-driven dynamic shards).
+
+Two loaders:
+
+- :class:`ElasticDataLoader` — the simple synchronous path (fetch and
+  ``np.stack`` in the training thread), kept as the A/B baseline.
+- :class:`PrefetchingDataLoader` — batches are assembled in a background
+  thread into a ring of reusable preallocated buffers (no per-batch
+  ``np.stack`` allocation churn) with a bounded depth, so record fetch
+  and batch assembly overlap the training step. Buffer ownership rule
+  (docs/DESIGN.md §24): a yielded batch's arrays are views into ring
+  buffers and stay valid ONLY until the next batch is requested; anything
+  that must outlive that (e.g. a host-side copy) must copy explicitly —
+  ``jax.device_put`` via :func:`device_put_prefetch` is already safe.
 """
 
-from typing import Callable, Iterator
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
 from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+_END = object()
 
 
 class ElasticDataLoader:
@@ -52,3 +70,244 @@ class ElasticDataLoader:
         return {
             k: np.stack([np.asarray(r[k]) for r in records]) for k in keys
         }
+
+
+class PrefetchingDataLoader:
+    """Double-buffered batch assembly over any record-index source.
+
+    A background assembler thread pulls indices from ``index_source``
+    (an :class:`IndexShardingClient`, a sampler, or any iterable of
+    ints), fetches records — optionally through a small thread pool —
+    and writes them row-by-row into one of ``depth + 1`` preallocated
+    buffer sets. Ready batches wait in a bounded queue; the consumer
+    recycles the previously yielded buffer set each time it asks for the
+    next batch.
+
+    ``sampler``: when given, ``sampler.record_batch(global_batch)`` is
+    called as each batch is YIELDED (not when it is assembled) so
+    checkpoint cursors count exactly the batches handed to training —
+    batches sitting assembled-but-unconsumed in the ring are not counted.
+    """
+
+    def __init__(
+        self,
+        fetch_record: Callable[[int], dict],
+        index_source: Iterable[int],
+        per_host_batch_size: int,
+        depth: int = 2,
+        num_workers: int = 0,
+        sampler: Optional[ElasticDistributedSampler] = None,
+        world_size: int = 1,
+    ):
+        if per_host_batch_size <= 0:
+            raise ValueError("per_host_batch_size must be positive")
+        self._fetch = fetch_record
+        self._source = index_source
+        self.per_host_batch_size = per_host_batch_size
+        self.depth = max(depth, 1)
+        self._num_workers = max(num_workers, 0)
+        self.sampler = sampler
+        self._world_size = (
+            sampler.world_size if sampler is not None else max(world_size, 1)
+        )
+        # depth ready slots + the one the consumer currently holds.
+        self._nslots = self.depth + 1
+        self._buffers: list = [None] * self._nslots
+        self._free: "queue.Queue[int]" = queue.Queue()
+        self._ready: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool = None
+        from dlrover_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        self._assembly_hist = reg.histogram(
+            "data_batch_assembly_seconds",
+            "wall time to assemble one host batch into the ring",
+        )
+        self._batch_wait = reg.counter(
+            "data_batch_wait_seconds_total",
+            "seconds the training thread waited for an assembled batch",
+        )
+        self._batches_total = reg.counter(
+            "data_batches_total", "host batches yielded to training"
+        )
+        self._ring_depth = reg.gauge(
+            "data_ready_batches", "assembled batches waiting for training"
+        )
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.per_host_batch_size * self._world_size
+
+    # ---- assembler thread --------------------------------------------------
+
+    def _alloc_slot(self, slot: int, proto: Dict[str, np.ndarray]):
+        self._buffers[slot] = {
+            k: np.empty(
+                (self.per_host_batch_size,) + v.shape, dtype=v.dtype
+            )
+            for k, v in proto.items()
+        }
+
+    def _assemble_loop(self):
+        try:
+            rows_iter = iter(self._source)
+            proto: Optional[Dict[str, np.ndarray]] = None
+            while not self._stopped.is_set():
+                try:
+                    slot = self._free.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                t0 = time.monotonic()
+                indices = []
+                for index in rows_iter:
+                    indices.append(index)
+                    if len(indices) == self.per_host_batch_size:
+                        break
+                    if self._stopped.is_set():
+                        return
+                if self._stopped.is_set():
+                    return
+                if len(indices) < self.per_host_batch_size:
+                    # Trailing partial batch dropped: static shapes keep
+                    # XLA happy (same contract as ElasticDataLoader).
+                    break
+                if self._pool is not None:
+                    records = list(self._pool.map(self._fetch, indices))
+                else:
+                    records = [self._fetch(i) for i in indices]
+                if proto is None:
+                    proto = {
+                        k: np.asarray(v) for k, v in records[0].items()
+                    }
+                if self._buffers[slot] is None:
+                    self._alloc_slot(slot, proto)
+                buf = self._buffers[slot]
+                for row, rec in enumerate(records):
+                    for k in buf:
+                        buf[k][row] = rec[k]
+                self._assembly_hist.observe(time.monotonic() - t0)
+                self._put_ready((slot, None))
+        except Exception as exc:  # noqa: BLE001 — surfaced to consumer
+            self._put_ready((None, exc))
+            return
+        self._put_ready(_END)
+
+    def _put_ready(self, item):
+        while not self._stopped.is_set():
+            try:
+                self._ready.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    # ---- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[dict]:
+        if self._thread is not None:
+            raise RuntimeError(
+                "PrefetchingDataLoader is single-pass: its index source "
+                "is consumed and its ring retired; build a new loader "
+                "per epoch (IndexShardingClient sources span epochs "
+                "master-side within one pass)"
+            )
+        if self._num_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._num_workers,
+                thread_name_prefix="data-fetch",
+            )
+        for slot in range(self._nslots):
+            self._free.put(slot)
+        self._thread = threading.Thread(
+            target=self._assemble_loop,
+            daemon=True,
+            name="batch-assembler",
+        )
+        self._thread.start()
+        held: Optional[int] = None
+        try:
+            while True:
+                t0 = time.monotonic()
+                while True:
+                    try:
+                        item = self._ready.get(timeout=0.2)
+                        break
+                    except queue.Empty:
+                        if self._stopped.is_set():
+                            # stop() from another thread while we were
+                            # blocked: the assembler's sentinel may have
+                            # been dropped — end cleanly, don't hang.
+                            self._batch_wait.inc(time.monotonic() - t0)
+                            return
+                self._batch_wait.inc(time.monotonic() - t0)
+                self._ring_depth.set(self._ready.qsize())
+                if held is not None:
+                    # The consumer is done with the previous buffers —
+                    # only now may the assembler overwrite them.
+                    self._free.put(held)
+                    held = None
+                if item is _END:
+                    return
+                slot, err = item
+                if err is not None:
+                    raise err
+                held = slot
+                if self.sampler is not None:
+                    # Cursor advances when training RECEIVES the batch,
+                    # mirroring ElasticDataLoader's resume contract.
+                    self.sampler.record_batch(self.global_batch_size)
+                self._batches_total.inc()
+                yield self._buffers[slot]
+        finally:
+            if held is not None:
+                self._free.put(held)
+            self.stop()
+
+    def stop(self):
+        self._stopped.set()
+        if self._thread is not None:
+            # Short join: the assembler polls _stopped between queue ops
+            # and index yields, but an index SOURCE wedged inside a
+            # blocking call can't be interrupted — abandon the daemon
+            # thread rather than stall teardown behind it.
+            self._thread.join(timeout=1.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+
+def device_put_prefetch(batches, sharding=None):
+    """Double-buffer host->device transfers: enqueue the H2D copy of the
+    NEXT batch, hand the caller the previous one, and only recycle the
+    host buffers after the in-flight transfer has landed. With a
+    :class:`PrefetchingDataLoader` source this makes the copy out of the
+    reusable ring buffers safe by construction, and the H2D of batch
+    ``n+1`` overlaps the training step on batch ``n``."""
+    import jax
+
+    # On the CPU backend device_put may ALIAS aligned host memory
+    # instead of copying — a jax.Array silently backed by a ring slot
+    # would be corrupted when the slot is recycled. A real accelerator's
+    # H2D is a true copy; there block_until_ready below is the fence.
+    aliasing = jax.default_backend() == "cpu"
+    prev = None
+    for host_batch in batches:
+        if aliasing:
+            host_batch = jax.tree_util.tree_map(np.array, host_batch)
+        if sharding is not None:
+            dev = jax.device_put(host_batch, sharding)
+        else:
+            dev = jax.device_put(host_batch)
+        if prev is not None:
+            yield prev
+        # The transfer reads from a reusable ring slot; it must complete
+        # before the next iterator advance can recycle that slot. By the
+        # time the caller asks for the next batch the previous step has
+        # already overlapped this wait.
+        jax.block_until_ready(dev)
+        prev = dev
+    if prev is not None:
+        yield prev

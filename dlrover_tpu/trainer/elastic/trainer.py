@@ -127,6 +127,23 @@ class ElasticTrainer:
         consumed = self.global_step * self.batch_config.global_batch_size
         return consumed // max(dataset_size, 1)
 
+    # ---- data pipeline -------------------------------------------------------
+
+    def device_prefetch(self, batches, sharding=None):
+        """Wrap a host-batch iterator (typically a
+        ``PrefetchingDataLoader``) with H2D double-buffering: the
+        ``jax.device_put`` of batch n+1 overlaps the step on batch n.
+        Yields on-device batches; safe over reusable ring buffers."""
+        from dlrover_tpu.trainer.elastic.dataloader import (
+            device_put_prefetch,
+        )
+
+        if self._flight_recorder is not None:
+            self._flight_recorder.annotate(
+                "device_prefetch_start", step=self.global_step
+            )
+        return device_put_prefetch(batches, sharding=sharding)
+
     # ---- restore -------------------------------------------------------------
 
     def restore_checkpoint(self, checkpointer, sharding_tree=None,
