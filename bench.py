@@ -299,6 +299,16 @@ def ce_ab_phase(out=None):
         return g
 
     out = {} if out is None else out
+    # What the production auto path actually runs at this shape: dense
+    # below the measured N*V crossover (r05: chunked = 1.042x dense
+    # just under the line), fused above it where the logits memory is
+    # what matters (ops/fused_ce.AUTO_FUSED_MIN_NV).
+    from dlrover_tpu.ops import fused_ce as _fce
+
+    out["ce_auto_path"] = (
+        "dense" if _fce.auto_prefers_dense(n, v) else "fused"
+    )
+    out["ce_auto_crossover_nv"] = _fce.AUTO_FUSED_MIN_NV
     td = _timed_op(grad_chain(dense), x, 30, overhead)
     out["ce_dense_ms"] = round(td * 1e3, 2)
     tc = _timed_op(grad_chain(chunked), x, 30, overhead)
@@ -1298,6 +1308,22 @@ def data_pipe_phase():
     return {f"data_pipe_{k}": v for k, v in r.items()}
 
 
+def serving_phase():
+    """Continuous batching vs drain-and-refill through the real serving
+    engine (tools/bench_serving.py): same compiled step programs, same
+    slot count, Poisson arrivals with bimodal output lengths. Host +
+    single-device jax — runs on every platform; zero retraces after
+    warmup are asserted inside the tool."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_serving
+
+    r = bench_serving.run_bench()
+    return {f"serving_{k}": v for k, v in r.items()}
+
+
 def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
@@ -1408,6 +1434,9 @@ _KEEP_KEYS = {
     "ckpt_io_persist_raw_mb_per_s",
     "data_pipe_speedup", "data_pipe_rpc_reduction",
     "data_pipe_records_per_s", "data_pipe_fetch_wait_frac",
+    "serving_tokens_per_s", "serving_speedup_vs_static",
+    "serving_ttft_p50_s", "serving_ttft_p99_s", "serving_slot_util",
+    "ce_auto_path",
     "prev_round_diff",
 }
 
@@ -1423,6 +1452,8 @@ _DROP_ORDER = (
     r"_error$|_timeout$",
     r"^data_pipe_(records$|shard_size|batch_size|rpc_latency|step_ms"
     r"|sync_|rpcs$)",
+    r"^serving_(static_|slots|requests|prefill_chunk|iterations"
+    r"|retraces|truncated)",
     r"^(ckpt_|raw_run_goodput|replay_s$|step_time_s|tokens_per_s)",
     r"^e2e_(detect|runtime|replay|replayed|autotuned|effective"
     r"|goodput_at|restore_s$|succeeded)",
@@ -1586,6 +1617,10 @@ def main():
         # Shard-pipeline scoreboard (prefetch/batching vs sync path);
         # pure host work, every platform.
         run_phase(result, "data_pipe", data_pipe_phase, est_s=30, cap_s=120)
+        # Continuous-batching vs drain-and-refill serving A/B; tiny
+        # model, every platform (the discipline, not the kernels, is
+        # what's measured — decode_phase owns the flagship kernels).
+        run_phase(result, "serving", serving_phase, est_s=60, cap_s=240)
     if platform != "cpu" and not fast:
         # Information-value order (VERDICT r4 #1c): headline compute +
         # CE + decode + longctx before the long tail.
@@ -1660,6 +1695,9 @@ def prev_round_diff(now: dict) -> dict:
         "e2e_goodput_pct",
         "decode_ms_per_token",
         "decode_vs_roofline",
+        "serving_tokens_per_s",
+        "serving_speedup_vs_static",
+        "serving_ttft_p99_s",
         "longctx_mfu_pct",
         "longctx_tokens_per_s",
         "ce_fused_chunked_vs_dense",

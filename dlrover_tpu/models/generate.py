@@ -11,8 +11,15 @@ The decode layer is BUILT FROM the training layer's own blocks
 (llama.attention_qkv / attention_out / mlp_block) plus the shared
 ``dot_product_attention`` — only the cache append is decode-specific,
 so dense-model training and generation cannot drift. Compiled programs
-are cached per (config, shapes, temperature), so repeated generate()
-calls retrace nothing.
+are cached per (config, shapes); temperature is a TRACED scalar, so
+per-request temperatures retrace nothing.
+
+The cache's fill cursor is a PER-ROW [b] int32 vector: generate() keeps
+every row at the same fill (its append is still one dynamic-update-
+slice at the shared cursor), while the continuous-batching serving
+engine (serving/engine.py) drives the same layer blocks with genuinely
+ragged per-slot fills — the masking (_append_free_attention,
+dot_product_attention positions) is per-row either way.
 
 MoE caveat: expert capacity is derived from the LOCAL sequence length
 of each call (models/moe.py expert_capacity), so token-drop behavior
@@ -38,7 +45,7 @@ from dlrover_tpu.ops.attention import dot_product_attention
 class DecodeCache(NamedTuple):
     k: jnp.ndarray  # [layers, b, max_len, kv_heads, head_dim]
     v: jnp.ndarray
-    length: jnp.ndarray  # [] int32 — tokens filled so far
+    length: jnp.ndarray  # [b] int32 — tokens filled so far, per row
 
 
 def init_cache(
@@ -60,8 +67,18 @@ def init_cache(
     return DecodeCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
-        length=jnp.zeros((), jnp.int32),
+        length=jnp.zeros((batch,), jnp.int32),
     )
+
+
+def _uniform_cursor(cache_len):
+    """Scalar write cursor from a scalar-or-[b] fill. The uniform
+    prefill/append paths (generate keeps every row at the same fill)
+    write with ONE dynamic-update-slice at the shared cursor; ragged
+    callers (the serving engine) never reach these paths — they append
+    with a per-row scatter instead."""
+    cl = jnp.asarray(cache_len)
+    return cl if cl.ndim == 0 else cl[0]
 
 
 def _decode_attn_impl() -> str:
@@ -80,7 +97,21 @@ def _decode_attn_impl() -> str:
     raw = os.environ.get("DLROVER_TPU_DECODE_ATTN", "auto").lower()
     if raw in ("pallas", "xla"):
         return raw
+    if raw != "auto" and raw not in _WARNED_ATTN_VALUES:
+        # A typo here must be LOUD: silently mapping e.g. "palas" to
+        # "xla" makes an intended kernel A/B measure the wrong path.
+        _WARNED_ATTN_VALUES.add(raw)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "DLROVER_TPU_DECODE_ATTN=%r is not one of ('pallas', "
+            "'xla', 'auto'); falling back to 'xla'",
+            raw,
+        )
     return "xla"
+
+
+_WARNED_ATTN_VALUES: set = set()
 
 
 def _fuse_decode_params(config, layers):
@@ -138,18 +169,20 @@ def _layer_decode(
     """One decoder block over [b, sq] new tokens with cache append.
     Returns (x, new_k_cache, new_v_cache). ``attn_impl`` ("pallas" |
     "xla") is resolved by the caller; None falls back to the env knob
-    (direct callers / tests)."""
+    (direct callers / tests). ``cache_len`` may be scalar or a UNIFORM
+    [b] vector — the append writes at the shared cursor."""
     residual = x
     if "wqkv" in p:
         q, k, v = _fused_qkv(config, p, x, positions)
     else:
         q, k, v = llama.attention_qkv(config, p, x, positions)
-    # Append the new tokens' K/V at the cache cursor.
+    # Append the new tokens' K/V at the (uniform) cache cursor.
+    cursor = _uniform_cursor(cache_len)
     k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0)
+        k_cache, k.astype(k_cache.dtype), (0, cursor, 0, 0)
     )
     v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0)
+        v_cache, v.astype(v_cache.dtype), (0, cursor, 0, 0)
     )
     max_len = k_cache.shape[1]
     block_k = next(
@@ -208,9 +241,10 @@ def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
     always its own last visible key), and the caller appends all
     layers' new K/V with ONE small dynamic-update-slice per token.
 
-    q: [b, 1, h, d]; k_cache/v_cache: [b, S, kh, d] (slots >=
-    cache_len unfilled); k_new/v_new: [b, 1, kh, d]. Returns
-    [b, 1, h, d].
+    q: [b, 1, h, d]; k_cache/v_cache: [b, S, kh, d] (rows >=
+    cache_len unfilled); k_new/v_new: [b, 1, kh, d]; cache_len scalar
+    or PER-ROW [b] int32 — ragged fills (the serving engine's slot
+    pool) mask each row at its own length. Returns [b, 1, h, d].
     """
     from dlrover_tpu.ops.attention import NEG_INF
 
@@ -219,19 +253,21 @@ def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
     g = h // kh
     scale = d ** -0.5
     q32 = (q[:, 0] * scale).astype(jnp.float32).reshape(b, kh, g, d)
-    # Cache part: [b, kh, g, S]; only filled slots are visible.
+    # Cache part: [b, kh, g, S]; only filled rows are visible — per
+    # row, so ragged slot fills mask independently.
     logits = jnp.einsum(
         "bkgd,bskd->bkgs", q32, k_cache.astype(jnp.float32)
     )
-    visible = jnp.arange(skv) < cache_len  # [S]
-    logits = jnp.where(visible[None, None, None, :], logits, NEG_INF)
+    lens = jnp.atleast_1d(jnp.asarray(cache_len, jnp.int32))
+    visible = jnp.arange(skv)[None, :] < lens[:, None]  # [1|b, S]
+    logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
     # New-token part: the query always sees itself.
     l_new = jnp.einsum(
         "bkgd,bkd->bkg", q32, k_new[:, 0].astype(jnp.float32)
     )
     m = jnp.maximum(jnp.max(logits, axis=-1), l_new)  # [b, kh, g]
     p = jnp.exp(logits - m[..., None])
-    p = jnp.where(visible[None, None, None, :], p, 0.0)
+    p = jnp.where(visible[:, None, None, :], p, 0.0)
     p_new = jnp.exp(l_new - m)
     denom = jnp.sum(p, axis=-1) + p_new  # >= p_new > 0
     out = (
@@ -246,7 +282,9 @@ def _layer_decode_read_only(
 ):
     """One decoder block over [b, 1] tokens; the cache is read-only.
     Returns (x, k_new [b, 1, kh, d], v_new) — the caller batches the
-    cache append across all layers (see _append_free_attention)."""
+    cache append across all layers (see _append_free_attention).
+    ``cache_len`` may be a ragged [b] vector: positions and masking are
+    per-row, which is what the serving engine's decode step drives."""
     residual = x
     if "wqkv" in p:
         q, k, v = _fused_qkv(config, p, x, positions)
@@ -286,11 +324,14 @@ def _forward_with_cache(
     unroll=None,
 ):
     """Run [b, sq] tokens through all layers, appending to the cache.
-    Returns (logits of the LAST position [b, vocab], new cache)."""
+    Returns (logits of the LAST position [b, vocab], new cache).
+    Uniform-fill contract: every row of ``cache.length`` holds the same
+    value (generate() only ever advances all rows together), so the
+    appends are single dynamic-update-slices at the shared cursor."""
     b, sq = tokens.shape
-    positions = cache.length + jnp.broadcast_to(
-        jnp.arange(sq, dtype=jnp.int32), (b, sq)
-    )
+    positions = cache.length[:, None] + jnp.arange(sq, dtype=jnp.int32)[
+        None, :
+    ]
     x = llama.embed_tokens(config, params, tokens)
     unroll = unroll or _layer_scan_unroll(config.n_layers)
 
@@ -312,13 +353,14 @@ def _forward_with_cache(
             body1, x, (params["layers"], cache.k, cache.v),
             unroll=unroll,
         )
+        cursor = _uniform_cursor(cache.length)
         new_k = jax.lax.dynamic_update_slice(
             cache.k, k_news.astype(cache.k.dtype),
-            (0, 0, cache.length, 0, 0),
+            (0, 0, cursor, 0, 0),
         )
         new_v = jax.lax.dynamic_update_slice(
             cache.v, v_news.astype(cache.v.dtype),
-            (0, 0, cache.length, 0, 0),
+            (0, 0, cursor, 0, 0),
         )
     else:
         def body(carry, layer_in):
@@ -338,6 +380,50 @@ def _forward_with_cache(
     return logits, new_cache
 
 
+def sample_token(logits, rng, temperature):
+    """Greedy-or-sampled next token over the last axis of ``logits``
+    ([V], [b, V], ...). ``temperature`` is a TRACED scalar or per-row
+    vector; <= 0 means argmax. Both branches trace (the categorical's
+    gumbel pass is noise next to never retracing on a temperature
+    change). ONE definition shared by generate()'s pick and the
+    serving engine's decode/prefill samplers — the sampling rule must
+    never drift between batch generation and serving."""
+    t = jnp.asarray(temperature, jnp.float32)
+    t_rows = t[..., None] if t.ndim else t
+    sampled = jax.random.categorical(
+        rng, logits / jnp.maximum(t_rows, 1e-6), axis=-1
+    ).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(t > 0.0, sampled, greedy)
+
+
+def prepare_decode_params(config, params):
+    """Decode-ready params: matmul leaves cast to the compute dtype
+    (decode is bandwidth-bound on parameter reads — measured 2.2ms/token
+    on v5e with f32 masters = one 1.3GB sweep per step; the cast cost
+    amortizes over the whole loop and every per-step read halves) plus
+    the fused wqkv/w_gu projections (_fuse_decode_params). Norm scales
+    and the MoE router stay f32 (same precision rule as
+    llama.run_layer_stack). Pure jnp: generate()'s jitted run calls it
+    traced, the serving engine calls it eagerly once per engine."""
+    cdt = config.compute_dtype
+    if cdt != jnp.float32:
+        keep = {"attn_norm", "mlp_norm", "router"}
+        params = {
+            "embed": params["embed"].astype(cdt),
+            "layers": {
+                k: (v if k in keep else v.astype(cdt))
+                for k, v in params["layers"].items()
+            },
+            "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"].astype(cdt),
+        }
+    return {
+        **params,
+        "layers": _fuse_decode_params(config, params["layers"]),
+    }
+
+
 class GenerateResult(NamedTuple):
     tokens: jnp.ndarray       # [b, max_new_tokens]
     cache: DecodeCache
@@ -349,55 +435,30 @@ def _compiled_generate(
     batch: int,
     max_new_tokens: int,
     max_len: int,
-    temperature: float,
     attn_impl: str = "xla",
     unroll: int = 0,
 ):
-    """One compiled program per (config, shapes, temperature,
-    attn_impl, unroll) — repeat generate() calls reuse it (jit caches
-    key on the function object, which must therefore be cached
-    itself). The decode-attention impl and the layer-scan unroll are
-    EXPLICIT cache-key arguments: generate() resolves their env knobs
-    per call, so toggling them takes effect without cache_clear()
-    (advisor r4)."""
+    """One compiled program per (config, shapes, attn_impl, unroll) —
+    repeat generate() calls reuse it (jit caches key on the function
+    object, which must therefore be cached itself). Temperature is a
+    TRACED scalar argument, NOT a cache key: per-request temperatures
+    (a serving workload's normal case) previously forced a full
+    retrace each time the value changed. The decode-attention impl and
+    the layer-scan unroll are EXPLICIT cache-key arguments: generate()
+    resolves their env knobs per call, so toggling them takes effect
+    without cache_clear() (advisor r4)."""
 
-    def pick(logits, rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+    pick = sample_token
 
-    def run(params, prompt, rng):
-        # Decode is bandwidth-bound on parameter reads (measured
-        # 2.2ms/token on v5e with f32 masters = one 1.3GB sweep per
-        # step). Cast matmul params to the compute dtype ONCE up front —
-        # the cast cost amortizes over the whole scan and every per-step
-        # read halves. Norm scales and the MoE router stay f32 (same
-        # precision rule as llama.run_layer_stack).
-        cdt = config.compute_dtype
-        if cdt != jnp.float32:
-            keep = {"attn_norm", "mlp_norm", "router"}
-            params = {
-                "embed": params["embed"].astype(cdt),
-                "layers": {
-                    k: (v if k in keep else v.astype(cdt))
-                    for k, v in params["layers"].items()
-                },
-                "final_norm": params["final_norm"],
-                "lm_head": params["lm_head"].astype(cdt),
-            }
-        params = {
-            **params,
-            "layers": _fuse_decode_params(config, params["layers"]),
-        }
+    def run(params, prompt, rng, temperature):
+        params = prepare_decode_params(config, params)
         cache = init_cache(config, batch, max_len)
         logits, cache = _forward_with_cache(
             config, params, prompt, cache, attn_impl=attn_impl,
             unroll=unroll or None,
         )
         rng, first_key = jax.random.split(rng)
-        first = pick(logits, first_key)
+        first = pick(logits, first_key, temperature)
 
         def step(carry, _):
             cache, tok, rng = carry
@@ -406,7 +467,7 @@ def _compiled_generate(
                 config, params, tok[:, None], cache,
                 attn_impl=attn_impl, unroll=unroll or None,
             )
-            nxt = pick(logits, sub)
+            nxt = pick(logits, sub, temperature)
             return (cache, nxt, rng), tok
 
         (cache, last, _), toks = jax.lax.scan(
@@ -443,9 +504,13 @@ def generate(
         raise ValueError("temperature > 0 requires an explicit rng key")
     rng = rng if rng is not None else jax.random.key(0)
     run = _compiled_generate(
-        config, b, max_new_tokens, max_len, float(temperature),
+        config, b, max_new_tokens, max_len,
         attn_impl=_decode_attn_impl(),
         unroll=_layer_scan_unroll(config.n_layers),
     )
-    tokens, cache = run(params, prompt, rng)
+    # np.float32, not a Python float: a weakly-typed scalar would give
+    # the traced argument a different avals key and retrace once.
+    import numpy as np
+
+    tokens, cache = run(params, prompt, rng, np.float32(temperature))
     return GenerateResult(tokens=tokens, cache=cache)

@@ -753,14 +753,19 @@ def resolve_ce_path(config, n_tokens: int) -> str:
     The chunked fused CE runs at ~0.99-1.07x dense on v5e (same three
     matmuls; gradients computed in the forward, see ops/fused_ce.py)
     while never materializing the [N, V] logits. "auto" engages it
-    once the f32 logits pass 2 GiB — at that scale the memory freed
-    matters (it is what lets the attn_save remat policy fit at 32k
-    tokens) and the time cost is a wash; below it, dense keeps its
-    measured edge on the flagship MFU path."""
+    only ABOVE the measured N*V crossover
+    (ops/fused_ce.AUTO_FUSED_MIN_NV ≈ 2 GiB of f32 logits): bench r05
+    measured the chunked path at 1.042x dense at the flagship shape
+    just below the line, while above it the memory freed is what lets
+    the attn_save remat policy fit at 32k tokens and the time cost is
+    a wash. Below the line dense keeps its measured edge on the
+    flagship MFU path."""
+    from dlrover_tpu.ops.fused_ce import auto_prefers_dense
+
     mode = _fused_ce_mode()
-    logits_bytes = n_tokens * config.vocab_size * 4
     use_fused = mode == "on" or (
-        mode == "auto" and logits_bytes > 2 * 1024**3
+        mode == "auto"
+        and not auto_prefers_dense(n_tokens, config.vocab_size)
     )
     if use_fused and _fused_ce_applicable(config):
         return "fused"

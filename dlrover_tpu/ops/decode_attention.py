@@ -6,13 +6,20 @@ step reads ``max_len`` KV rows even when only ``length`` are filled,
 and the masked softmax touches the padding too. Decode is HBM-bound,
 so those wasted reads are wasted milliseconds.
 
-This kernel is length-aware: the fill length rides as a scalar-prefetch
-argument, the KV block index map CLAMPS past-the-end blocks to the last
-valid block (Mosaic skips the HBM copy when a block index repeats), and
-``pl.when`` skips their compute. Per (batch, kv-head) grid cell the
-query group (GQA: n_heads // n_kv_heads rows, padded to the 8-sublane
-minimum) runs an online-softmax sweep over KV blocks — flash attention
-with a 1-token query.
+This kernel is length-aware PER ROW: the fill lengths ride as a [b]
+scalar-prefetch vector (a scalar is broadcast, so uniform-fill callers
+are unchanged), the KV block index map CLAMPS past-the-end blocks to
+the row's own last valid block (Mosaic skips the HBM copy when a block
+index repeats), and ``pl.when`` skips their compute. Per (batch,
+kv-head) grid cell the query group (GQA: n_heads // n_kv_heads rows,
+padded to the 8-sublane minimum) runs an online-softmax sweep over KV
+blocks — flash attention with a 1-token query.
+
+Ragged fills are where the kernel earns its keep: the continuous-
+batching serving engine (serving/engine.py) holds slots at wildly
+different fill lengths, and a padded whole-cache XLA read wastes HBM
+bandwidth proportional to the raggedness, while this grid clamps each
+slot to its own fill.
 
 Parity note: the reference delegates decode to vLLM/torch kernels
 (paged attention); this is the TPU-native analogue for this repo's
@@ -33,6 +40,7 @@ def _kernel(
     len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, block_k: int, scale: float,
 ):
+    ib = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -42,7 +50,7 @@ def _kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    length = len_ref[0]
+    length = len_ref[ib]
     base = j * block_k
 
     @pl.when(base < length)
@@ -84,11 +92,17 @@ def decode_attention(
     q,            # [b, n_heads, d] — ONE query token per sequence
     k_cache,      # [b, max_len, kv_heads, d]
     v_cache,
-    length,       # [] int32 — filled cache rows (uniform over batch)
+    length,       # [] or [b] int32 — filled cache rows per sequence
     block_k: int = 128,
     interpret=None,
 ):
-    """Length-masked single-query attention; returns [b, n_heads, d]."""
+    """Length-masked single-query attention; returns [b, n_heads, d].
+
+    ``length`` may be a scalar (uniform fill — every row clamps to the
+    same block range, the original generate() contract) or a [b] vector
+    of per-row fills (ragged slots — the serving engine's case, where
+    each (batch, kv-head) grid cell reads only its own row's filled
+    blocks). Rows with length 0 produce zero output."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, d = q.shape
@@ -102,7 +116,9 @@ def decode_attention(
     qg = q.reshape(b, kh, g, d)
     if gp != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
-    length = jnp.asarray(length, jnp.int32).reshape(1)
+    length = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (b,)
+    )
     nj = max_len // block_k
     if max_len % block_k:
         raise ValueError(
@@ -110,10 +126,10 @@ def decode_attention(
         )
 
     def kv_index(ib, ih, j, len_ref):
-        # Clamp past-the-fill blocks to the last valid one: Mosaic skips
-        # the HBM copy when the index repeats, so unfilled cache rows
-        # are never read.
-        last = jnp.maximum((len_ref[0] - 1) // block_k, 0)
+        # Clamp past-the-fill blocks to THIS ROW's last valid one:
+        # Mosaic skips the HBM copy when the index repeats, so unfilled
+        # cache rows are never read — per sequence, not per batch.
+        last = jnp.maximum((len_ref[ib] - 1) // block_k, 0)
         return (ib, jnp.minimum(j, last), ih)
 
     # Mosaic wants the trailing two block dims (8, 128)-divisible: view

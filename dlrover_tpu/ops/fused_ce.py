@@ -48,6 +48,24 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 LANES = 128
 
+# Measured dense/fused crossover in N*V elements (f32-logits bytes / 4).
+# Evidence (v5e, bench r05): at the flagship head shape n=16384,
+# v=32000 — N*V = 5.24e8, just below this line — the chunked fused path
+# ran at 1.042x DENSE (the [d, V] f32 dw-carry HBM round-trip per row
+# chunk is pure overhead while the logits still fit), so dense keeps
+# its edge below the line; above it the ~2 GiB+ logits are what stop
+# long-context steps from fitting (the attn_save remat budget), and the
+# fused path's time cost is a wash. llama.resolve_ce_path delegates
+# here; the CE A/B bench reports the choice (ce_auto_path).
+AUTO_FUSED_MIN_NV = 2 * 1024**3 // 4
+
+
+def auto_prefers_dense(n_tokens: int, vocab: int) -> bool:
+    """True when CE "auto" should run the DENSE logits path for a batch
+    of ``n_tokens`` rows over ``vocab`` classes (below the measured
+    crossover, see AUTO_FUSED_MIN_NV)."""
+    return n_tokens * vocab < AUTO_FUSED_MIN_NV
+
 
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m

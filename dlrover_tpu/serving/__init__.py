@@ -1,0 +1,25 @@
+"""Continuous-batching serving engine (slot-pooled KV cache, ragged
+per-slot decode, iteration-level scheduling). See engine.py for the
+design and docs/DESIGN.md §25 for the invariants."""
+
+from dlrover_tpu.serving.engine import ServingEngine
+from dlrover_tpu.serving.scheduler import (
+    DECODE,
+    DONE,
+    PREFILL,
+    QUEUED,
+    Request,
+    Scheduler,
+)
+from dlrover_tpu.serving.metrics import serving_metrics
+
+__all__ = [
+    "ServingEngine",
+    "Scheduler",
+    "Request",
+    "QUEUED",
+    "PREFILL",
+    "DECODE",
+    "DONE",
+    "serving_metrics",
+]

@@ -1,0 +1,419 @@
+"""Continuous-batching decode engine: slot-pooled KV cache, ragged
+per-slot fills, iteration-level scheduling.
+
+The only inference entry point before this was ``models/generate.py`` —
+a fixed-batch ``lax.scan`` whose fill cursor is shared by every row, so
+a batch can only hold same-phase sequences and admitting new work means
+draining the batch and re-prefilling everything. This engine is the
+serving-shaped alternative (Orca's iteration-level scheduling over this
+repo's single-slab cache — the TPU-native analogue of vLLM's pooled
+blocks, one slot = one sequence's [max_len] slab):
+
+- **Slot pool.** One [layers, slots, max_len, kv_heads, head_dim] K and
+  V slab, allocated once, DONATED through every step call so XLA
+  updates it in place — admissions/evictions/completions never change a
+  traced shape; occupancy is a [slots] mask and per-slot fill lengths
+  are a [slots] int32 vector.
+- **Ragged decode.** One compiled step decodes every active slot at its
+  OWN fill length: per-row positions drive RoPE, per-row masking drives
+  the append-free attention (models/generate._append_free_attention),
+  and the append is a per-row scatter at each slot's cursor. Inactive
+  slots compute masked garbage that lands only in never-visible rows
+  (the visibility invariant, docs/DESIGN.md §25).
+- **Chunked prefill.** Prompts enter ``prefill_chunk`` tokens at a time
+  through a second compiled program (one slot per call), so a long
+  prompt interleaves with decode iterations instead of stalling them.
+- **Zero retraces.** Both programs are compiled once per
+  (config, slots, max_len, chunk) and every dynamic quantity — slot
+  index, cursor, lengths, occupancy, temperatures, sampling step — is a
+  traced argument. ``trace_counts`` exposes the compile counter the
+  no-retrace tests and the serving bench assert on.
+
+Typical use::
+
+    eng = ServingEngine(cfg, params, slots=8, max_len=1024)
+    eng.submit(prompt_ids, max_new_tokens=64, temperature=0.8)
+    while eng.pending():
+        for req in eng.step():
+            consume(req.rid, req.tokens)
+"""
+
+import functools
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import generate as gen_lib
+from dlrover_tpu.models import llama
+from dlrover_tpu.serving import scheduler as sched_lib
+from dlrover_tpu.serving.metrics import serving_metrics
+from dlrover_tpu.serving.scheduler import DECODE, PREFILL, Request, Scheduler
+
+
+class _CompiledSteps(NamedTuple):
+    prefill: object
+    decode: object
+    trace_counts: Dict[str, int]
+
+
+def _build_decode_step(config, slots: int, max_len: int, counts):
+    """[slots] tokens -> one decoded token per slot, ragged lengths.
+
+    The cache is read by the layer scan (append-free attention) and the
+    new K/V of ALL layers land with one per-row scatter at each slot's
+    own cursor — the ragged generalization of generate()'s single
+    dynamic-update-slice."""
+
+    def step(k, v, params, lengths, tokens, active, temps, rng, step_idx):
+        counts["decode"] += 1  # traces only; execution never reaches here
+        positions = lengths[:, None]                     # [slots, 1]
+        x = llama.embed_tokens(config, params, tokens[:, None])
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_new, v_new = gen_lib._layer_decode_read_only(
+                config, pl, carry, positions, k_c, v_c, lengths
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v)
+        )
+        # Per-row append at each slot's cursor. Inactive slots write
+        # garbage into rows that are not visible (>= fill) and are
+        # always rewritten before any cursor passes them; the clamp
+        # keeps a full stale slot's scatter in bounds.
+        row = jnp.arange(slots)
+        write = jnp.minimum(lengths, max_len - 1)
+        k = k.at[:, row, write].set(k_news[:, :, 0].astype(k.dtype))
+        v = v.at[:, row, write].set(v_news[:, :, 0].astype(v.dtype))
+        logits = llama.unembed(config, params, x)[:, 0]   # [slots, V]
+        sub = jax.random.fold_in(rng, step_idx * 2)
+        nxt = gen_lib.sample_token(logits, sub, temps)
+        # Inactive slots keep their fed token (the host ignores them,
+        # but a stable value keeps replays deterministic).
+        nxt = jnp.where(active, nxt, tokens)
+        return k, v, nxt
+
+    return step
+
+
+def _build_prefill_chunk(config, slots: int, max_len: int, chunk: int,
+                         counts):
+    """One prompt chunk ([1, chunk] tokens) into ONE slot's cache rows
+    [start, start+chunk), plus the first sampled token (meaningful only
+    on the final chunk — taken at the last REAL prompt position
+    ``n_valid - 1``; pad rows beyond it hold garbage K/V that stays
+    invisible)."""
+
+    L = config.n_layers
+    kh, hd = config.n_kv_heads, config.head_dim
+
+    def prefill(k, v, params, tokens, slot, start, n_valid, temp, rng,
+                step_idx):
+        counts["prefill"] += 1  # traces only
+        k_slot = jax.lax.dynamic_slice(
+            k, (0, slot, 0, 0, 0), (L, 1, max_len, kh, hd)
+        )
+        v_slot = jax.lax.dynamic_slice(
+            v, (0, slot, 0, 0, 0), (L, 1, max_len, kh, hd)
+        )
+        positions = (
+            start + jnp.arange(chunk, dtype=jnp.int32)
+        )[None, :]
+        x = llama.embed_tokens(config, params, tokens)
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_c, v_c = gen_lib._layer_decode(
+                config, pl, carry, positions, k_c, v_c, start,
+                attn_impl="xla",
+            )
+            return y, (k_c, v_c)
+
+        x, (k_slot, v_slot) = jax.lax.scan(
+            body, x, (params["layers"], k_slot, v_slot)
+        )
+        k = jax.lax.dynamic_update_slice(
+            k, k_slot.astype(k.dtype), (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            v, v_slot.astype(v.dtype), (0, slot, 0, 0, 0)
+        )
+        h = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        logits = llama.unembed(config, params, h)[0, 0]    # [V]
+        sub = jax.random.fold_in(rng, step_idx * 2 + 1)
+        first = gen_lib.sample_token(logits, sub, temp)
+        return k, v, first
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_steps(
+    config: llama.TpuLMConfig, slots: int, max_len: int, chunk: int
+) -> _CompiledSteps:
+    """Both step programs, compiled once per shape key and SHARED by
+    every engine with the same key (the bench's continuous and static
+    engines reuse one compile). The KV slabs are donated so the pool is
+    updated in place; everything else is a plain traced argument."""
+    counts = {"prefill": 0, "decode": 0}
+    decode = jax.jit(
+        _build_decode_step(config, slots, max_len, counts),
+        donate_argnums=(0, 1),
+    )
+    prefill = jax.jit(
+        _build_prefill_chunk(config, slots, max_len, chunk, counts),
+        donate_argnums=(0, 1),
+    )
+    return _CompiledSteps(prefill=prefill, decode=decode,
+                          trace_counts=counts)
+
+
+class ServingEngine:
+    """Single-host continuous-batching engine over a slot-pooled cache.
+
+    Host bookkeeping (the Scheduler) is jax-free; each ``step()`` runs
+    at most one prefill chunk and one ragged decode iteration. The
+    engine is not thread-safe — drive it from one serving loop."""
+
+    def __init__(
+        self,
+        config: llama.TpuLMConfig,
+        params,
+        slots: int,
+        max_len: int,
+        prefill_chunk: int = 64,
+        token_budget: Optional[int] = None,
+        drain_mode: bool = False,
+        rng: Optional[jax.Array] = None,
+        registry=None,
+    ):
+        if config.pp_stages > 1:
+            raise NotImplementedError(
+                "serving runs on the flat layer stack; merge pipeline "
+                "stages for inference"
+            )
+        if max_len % 8:
+            raise ValueError("max_len must be a multiple of 8")
+        if max_len % prefill_chunk:
+            # The final chunk of a near-full prompt would otherwise
+            # start at a non-chunk-aligned cursor close enough to the
+            # end that its fixed-size dynamic_update_slice CLAMPS —
+            # silently rewriting already-visible rows below the cursor
+            # with K/V computed for later positions. Chunk starts are
+            # always multiples of prefill_chunk (partial chunks only
+            # ever END a prompt), so divisibility makes the clamp
+            # unreachable.
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of "
+                f"prefill_chunk {prefill_chunk}"
+            )
+        self.config = config
+        self.slots = slots
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self.scheduler = Scheduler(
+            slots, max_len, prefill_chunk, token_budget, drain_mode
+        )
+        self.metrics = serving_metrics(registry)
+        self.metrics.slots_total.set(slots)
+        self._params = gen_lib.prepare_decode_params(config, params)
+        self._steps = _compiled_steps(config, slots, max_len,
+                                      prefill_chunk)
+        self._trace_snapshot = dict(self._steps.trace_counts)
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self._step_idx = 0
+        self._k, self._v = self._fresh_pool()
+        # Host mirrors of the device-side per-slot state; passed into
+        # every step call (tiny H2D) so host and device can never
+        # drift.
+        self._lengths = np.zeros(slots, np.int32)
+        self._tokens = np.zeros(slots, np.int32)
+        self._temps = np.zeros(slots, np.float32)
+
+    def _fresh_pool(self):
+        shape = (
+            self.config.n_layers, self.slots, self.max_len,
+            self.config.n_kv_heads, self.config.head_dim,
+        )
+        dtype = self.config.compute_dtype
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    # ---- public API --------------------------------------------------------
+
+    @property
+    def trace_counts(self) -> Dict[str, int]:
+        """Compile counter per step program (shared across engines with
+        the same shape key) — flat after warmup or something retraced."""
+        return dict(self._steps.trace_counts)
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> Request:
+        req = self.scheduler.submit(prompt, max_new_tokens, temperature)
+        self.metrics.queue_depth.set(len(self.scheduler.queue))
+        return req
+
+    def cancel(self, req: Request) -> None:
+        """Evict a live request; its slot is recycled immediately."""
+        if req.state == sched_lib.DONE:
+            return
+        if req.state == sched_lib.QUEUED:
+            try:
+                self.scheduler.queue.remove(req)
+            except ValueError:
+                pass
+        self.scheduler.evict(req)
+        self.metrics.requests.inc(outcome="cancelled")
+        self.metrics.annotate("serving_evict", rid=req.rid)
+
+    def pending(self) -> int:
+        """Requests not yet DONE (queued + in a slot)."""
+        return len(self.scheduler.queue) + len(self.scheduler.active())
+
+    def warmup(self) -> None:
+        """Compile both step programs on throwaway state, then reset the
+        pool — so the first real request pays no compile and the
+        trace counters are settled for no-retrace assertions."""
+        chunk = np.zeros((1, self.prefill_chunk), np.int32)
+        k, v, first = self._steps.prefill(
+            self._k, self._v, self._params, jnp.asarray(chunk),
+            np.int32(0), np.int32(0), np.int32(1), np.float32(0.0),
+            self._rng, np.int32(0),
+        )
+        k, v, nxt = self._steps.decode(
+            k, v, self._params,
+            jnp.asarray(np.zeros(self.slots, np.int32)),
+            jnp.asarray(np.zeros(self.slots, np.int32)),
+            jnp.asarray(np.zeros(self.slots, bool)),
+            jnp.asarray(np.zeros(self.slots, np.float32)),
+            self._rng, np.int32(0),
+        )
+        jax.block_until_ready(nxt)
+        del k, v
+        self._k, self._v = self._fresh_pool()
+        self._trace_snapshot = dict(self._steps.trace_counts)
+
+    def step(self) -> List[Request]:
+        """One scheduler iteration: admissions, at most one prefill
+        chunk, one ragged decode step. Returns requests finished THIS
+        iteration (tokens fully populated)."""
+        t0 = time.monotonic()
+        sch = self.scheduler
+        finished: List[Request] = []
+        for req in sch.admit():
+            # A recycled slot starts from fill 0: stale KV above the
+            # cursor is invisible and rewritten before visibility.
+            self._lengths[req.slot] = 0
+            self._tokens[req.slot] = 0
+            self._temps[req.slot] = req.temperature
+            self.metrics.requests.inc(outcome="admitted")
+            self.metrics.annotate(
+                "serving_admit", rid=req.rid, slot=req.slot,
+                prompt_len=req.prompt_len,
+            )
+        pf = sch.pick_prefill()
+        if pf is not None:
+            self._run_prefill_chunk(pf, finished)
+        decoding = sch.decoding()
+        if decoding:
+            self._run_decode(decoding, finished)
+        self._step_idx += 1
+        self.metrics.iterations.inc()
+        self.metrics.queue_depth.set(len(sch.queue))
+        self.metrics.active_slots.set(len(sch.active()))
+        self._sync_retrace_metric()
+        if decoding:
+            dt = time.monotonic() - t0
+            for _ in decoding:
+                self.metrics.token_latency.observe(dt)
+        return finished
+
+    def run_until_idle(self, max_iters: int = 100000) -> List[Request]:
+        """Drive step() until nothing is pending; returns all finished."""
+        done: List[Request] = []
+        for _ in range(max_iters):
+            if not self.pending():
+                return done
+            done.extend(self.step())
+        raise RuntimeError(
+            f"engine did not drain within {max_iters} iterations"
+        )
+
+    # ---- internals ---------------------------------------------------------
+
+    def _run_prefill_chunk(self, req: Request, finished: List[Request]):
+        c = self.prefill_chunk
+        start = req.prefill_pos
+        n_valid = min(c, req.prompt_len - start)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, :n_valid] = req.prompt[start:start + n_valid]
+        self._k, self._v, first = self._steps.prefill(
+            self._k, self._v, self._params, jnp.asarray(chunk),
+            np.int32(req.slot), np.int32(start), np.int32(n_valid),
+            np.float32(req.temperature), self._rng,
+            np.int32(self._step_idx),
+        )
+        req.prefill_pos += n_valid
+        self._lengths[req.slot] = req.prefill_pos
+        self.metrics.tokens.inc(n_valid, kind="prefill")
+        if req.prefill_pos < req.prompt_len:
+            return  # more chunks to come; `first` is discarded unfetched
+        tok = int(jax.device_get(first))
+        req.first_token_ts = time.monotonic()
+        self.metrics.ttft.observe(req.ttft_s)
+        req.tokens.append(tok)
+        self._tokens[req.slot] = tok
+        self.metrics.tokens.inc(kind="decode")
+        if len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, finished)
+        else:
+            req.state = DECODE
+
+    def _run_decode(self, decoding: List[Request],
+                    finished: List[Request]):
+        active = np.zeros(self.slots, bool)
+        for r in decoding:
+            active[r.slot] = True
+        self._k, self._v, nxt = self._steps.decode(
+            self._k, self._v, self._params,
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(active), jnp.asarray(self._temps),
+            self._rng, np.int32(self._step_idx),
+        )
+        nxt = np.asarray(jax.device_get(nxt))
+        for r in decoding:
+            self._lengths[r.slot] += 1   # the fed token's KV landed
+            tok = int(nxt[r.slot])
+            r.tokens.append(tok)
+            self._tokens[r.slot] = tok
+            self.metrics.tokens.inc(kind="decode")
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish(r, finished)
+            elif self._lengths[r.slot] + 1 > self.max_len:
+                # No room to feed the token just sampled.
+                r.truncated = True
+                self._finish(r, finished)
+
+    def _finish(self, req: Request, finished: List[Request]):
+        slot = req.slot
+        self.scheduler.finish(req)
+        finished.append(req)
+        self.metrics.requests.inc(
+            outcome="truncated" if req.truncated else "finished"
+        )
+        self.metrics.annotate(
+            "serving_finish", rid=req.rid, slot=slot,
+            new_tokens=len(req.tokens), truncated=req.truncated,
+        )
+
+    def _sync_retrace_metric(self):
+        now = self._steps.trace_counts
+        delta = sum(now.values()) - sum(self._trace_snapshot.values())
+        if delta > 0:
+            self.metrics.retraces.inc(delta)
+            self._trace_snapshot = dict(now)

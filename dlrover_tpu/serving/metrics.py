@@ -1,0 +1,102 @@
+"""Serving metrics: the engine's view into the observability hub.
+
+One call wires the continuous-batching engine into the SAME process
+registry the master scrapes (observability/registry.py) — queue depth,
+slot occupancy, TTFT, per-token latency, token/request counters — so a
+serving job's health rides the existing /metrics exposition and the
+flight-recorder ring with zero new plumbing.
+
+Registration is idempotent (the registry returns existing families), so
+multiple engines in one process share counters; gauges describe the
+LAST engine to update them, which is the single-engine common case.
+"""
+
+from typing import Optional
+
+from dlrover_tpu.observability.registry import default_registry
+
+# Sub-second buckets: decode iterations are milliseconds, not the
+# registry's default 5ms..300s I/O scale.
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0,
+)
+_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class ServingMetrics:
+    """Handle bundle over the registry families the engine updates."""
+
+    def __init__(self, registry=None):
+        reg = registry or default_registry()
+        self.queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting for a slot"
+        )
+        self.active_slots = reg.gauge(
+            "serving_active_slots", "slots holding a live request"
+        )
+        self.slots_total = reg.gauge(
+            "serving_slots_total", "slot-pool size of the engine"
+        )
+        self.requests = reg.counter(
+            "serving_requests_total",
+            "requests by lifecycle outcome",
+            labelnames=("outcome",),
+        )
+        self.tokens = reg.counter(
+            "serving_tokens_total",
+            "tokens processed, prefill (prompt) vs decode (generated)",
+            labelnames=("kind",),
+        )
+        self.iterations = reg.counter(
+            "serving_iterations_total", "engine scheduler iterations"
+        )
+        self.retraces = reg.counter(
+            "serving_retraces_total",
+            "step-program traces (must stay flat after warmup)",
+        )
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "submit-to-first-token latency",
+            buckets=_TTFT_BUCKETS,
+        )
+        self.token_latency = reg.histogram(
+            "serving_token_latency_seconds",
+            "per-decoded-token latency (iteration wall time)",
+            buckets=_LATENCY_BUCKETS,
+        )
+
+    def annotate(self, event: str, **fields):
+        """Drop a marker in the flight-recorder ring IF one is armed —
+        admissions/evictions then land in the merged job timeline next
+        to training steps. Never creates a recorder."""
+        from dlrover_tpu.observability.flight_recorder import (
+            active_recorder,
+        )
+
+        rec = active_recorder()
+        if rec is not None:
+            rec.annotate(event, **fields)
+
+
+_metrics: Optional[ServingMetrics] = None
+
+
+def serving_metrics(registry=None) -> ServingMetrics:
+    """Process-wide handle (or a private one for a passed registry)."""
+    global _metrics
+    if registry is not None:
+        return ServingMetrics(registry)
+    if _metrics is None:
+        _metrics = ServingMetrics()
+    return _metrics
+
+
+def reset_serving_metrics():
+    """Tests only: forget the cached handle (the registry itself is
+    reset separately via reset_default_registry)."""
+    global _metrics
+    _metrics = None

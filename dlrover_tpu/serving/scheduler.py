@@ -1,0 +1,176 @@
+"""Request state machine + iteration-level scheduler for the engine.
+
+Orca-style continuous batching, host side: requests move QUEUED →
+PREFILL → DECODE → DONE; a slot is the unit of admission (one request
+owns one row of the engine's [slots, max_len] KV pool) and is recycled
+the moment its request finishes — no drain, no re-prefill of survivors.
+Stale KV left in a recycled slot is harmless by the visibility
+invariant (rows >= length are never read; see docs/DESIGN.md §25), so
+"compaction" is pure bookkeeping: the free-list.
+
+Per-iteration token budget: one scheduler tick admits at most one
+prefill CHUNK (``prefill_chunk`` prompt tokens) alongside the decode
+step's one-token-per-active-slot, and the chunk only runs when
+``decoding + prefill_chunk <= token_budget`` (or nothing is decoding).
+Lowering the budget protects decode latency from prefill bursts;
+the default (prefill_chunk + slots) never blocks a chunk.
+
+The scheduler is deliberately jax-free — pure host bookkeeping the
+engine drives — so its policies are unit-testable without tracing.
+"""
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+# Request lifecycle states.
+QUEUED = "queued"
+PREFILL = "prefill"
+DECODE = "decode"
+DONE = "done"
+
+
+@dataclass
+class Request:
+    """One generation request and its accumulated result."""
+
+    rid: int
+    prompt: np.ndarray                 # [prompt_len] int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    state: str = QUEUED
+    slot: int = -1
+    prefill_pos: int = 0               # prompt rows already in the cache
+    tokens: List[int] = field(default_factory=list)
+    truncated: bool = False            # hit max_len before max_new_tokens
+    submit_ts: float = 0.0
+    first_token_ts: Optional[float] = None
+    finish_ts: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.submit_ts
+
+
+class Scheduler:
+    """Slot bookkeeping + admission policy (see module docstring)."""
+
+    def __init__(
+        self,
+        slots: int,
+        max_len: int,
+        prefill_chunk: int,
+        token_budget: Optional[int] = None,
+        drain_mode: bool = False,
+    ):
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.slots = slots
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self.token_budget = (
+            token_budget if token_budget is not None
+            else prefill_chunk + slots
+        )
+        # drain_mode is the NAIVE static baseline the serving bench A/Bs
+        # against: admit a full batch, run it to completion, only then
+        # refill — no slot is recycled while any peer still decodes.
+        self.drain_mode = drain_mode
+        self.queue: Deque[Request] = deque()
+        self.by_slot: List[Optional[Request]] = [None] * slots
+        self._free: Deque[int] = deque(range(slots))
+        self._rid = itertools.count()
+
+    # ---- submission / admission -------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        now: Optional[float] = None,
+    ) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.shape[0] >= self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt.shape[0]} leaves no decode room in "
+                f"max_len {self.max_len}"
+            )
+        req = Request(
+            rid=next(self._rid),
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            temperature=float(temperature),
+            submit_ts=now if now is not None else time.monotonic(),
+        )
+        self.queue.append(req)
+        return req
+
+    def admit(self) -> List[Request]:
+        """Bind queued requests to free slots (FCFS). Under drain_mode,
+        only when EVERY slot is free — the drain-and-refill baseline."""
+        if self.drain_mode and len(self._free) < self.slots:
+            return []
+        admitted = []
+        while self.queue and self._free:
+            req = self.queue.popleft()
+            req.slot = self._free.popleft()
+            req.state = PREFILL
+            self.by_slot[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    # ---- per-iteration work selection -------------------------------------
+
+    def decoding(self) -> List[Request]:
+        return [r for r in self.by_slot if r is not None and r.state == DECODE]
+
+    def active(self) -> List[Request]:
+        return [r for r in self.by_slot if r is not None]
+
+    def pick_prefill(self) -> Optional[Request]:
+        """The prefill chunk to run this iteration, or None. FCFS among
+        PREFILL slots (lowest rid = longest waiting); gated by the
+        token budget so a prompt burst cannot starve decode."""
+        cands = [
+            r for r in self.by_slot
+            if r is not None and r.state == PREFILL
+        ]
+        if not cands:
+            return None
+        n_decoding = len(self.decoding())
+        if n_decoding and n_decoding + self.prefill_chunk > self.token_budget:
+            return None
+        return min(cands, key=lambda r: r.rid)
+
+    # ---- completion --------------------------------------------------------
+
+    def finish(self, req: Request, now: Optional[float] = None) -> None:
+        """DONE + recycle the slot. The stale KV stays in place: rows
+        >= the next occupant's fill are invisible and every row is
+        overwritten before its fill cursor passes it."""
+        req.state = DONE
+        req.finish_ts = now if now is not None else time.monotonic()
+        if req.slot >= 0:
+            self.by_slot[req.slot] = None
+            self._free.append(req.slot)
+            req.slot = -1
+
+    def evict(self, req: Request, now: Optional[float] = None) -> None:
+        """Drop a live request (cancellation). Identical bookkeeping to
+        finish(); split so callers/metrics can tell outcomes apart."""
+        self.finish(req, now)

@@ -28,7 +28,9 @@ def test_prefill_logits_match_forward(tiny):
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full[:, -1, :]), rtol=2e-4, atol=2e-4
     )
-    assert int(cache.length) == 7
+    # Per-row fill cursor: generate keeps every row uniform.
+    assert cache.length.shape == (2,)
+    assert [int(v) for v in cache.length] == [7, 7]
 
 
 def test_incremental_decode_matches_forward(tiny):
@@ -178,6 +180,89 @@ def test_append_free_attention_matches_padded_cache_path():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_temperature_change_does_not_retrace(tiny):
+    """Per-request temperatures are a traced scalar, not a compile
+    key: sweeping the temperature must reuse ONE compiled program."""
+    from dlrover_tpu.models.generate import _compiled_generate
+
+    cfg, params = tiny
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    _compiled_generate.cache_clear()
+    outs = {}
+    for t in (0.0, 0.7, 1.3):
+        rng = jax.random.key(11) if t > 0 else None
+        outs[t] = gen.generate(
+            cfg, params, prompt, 4, temperature=t, rng=rng
+        )
+    assert _compiled_generate.cache_info().currsize == 1
+    # Greedy (t=0) still means argmax even though the program traces
+    # both branches.
+    logits, _ = llama.forward(cfg, params, prompt)
+    assert int(outs[0.0].tokens[0, 0]) == int(
+        jnp.argmax(logits[0, -1])
+    )
+    _compiled_generate.cache_clear()
+
+
+def test_decode_attn_env_typo_warns(monkeypatch):
+    """An unrecognized DLROVER_TPU_DECODE_ATTN value must warn (naming
+    the accepted values) instead of silently running xla. A handler is
+    attached to the module logger directly: the repo's shared logging
+    setup turns off propagation, so caplog's root handler would not
+    see the record in a full-suite run."""
+    import logging
+
+    from dlrover_tpu.models import generate as g
+
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    log = logging.getLogger(g.__name__)
+    handler = Grab(level=logging.WARNING)
+    log.addHandler(handler)
+    try:
+        monkeypatch.setenv("DLROVER_TPU_DECODE_ATTN", "palas")
+        g._WARNED_ATTN_VALUES.clear()
+        assert g._decode_attn_impl() == "xla"
+        assert any("palas" in m and "pallas" in m for m in records)
+        # Warn once per distinct value, not per call.
+        n = len(records)
+        assert g._decode_attn_impl() == "xla"
+        assert len(records) == n
+    finally:
+        log.removeHandler(handler)
+
+
+def test_append_free_attention_ragged_lengths():
+    """Per-row cache_len vector: each row masks at its own fill — the
+    serving engine's decode step. Every row must equal the same row
+    run alone with its scalar length."""
+    from dlrover_tpu.models.generate import _append_free_attention
+
+    b, S, h, kh, d = 4, 32, 4, 2, 16
+    lens = jnp.array([0, 5, 17, 31], jnp.int32)
+    ks = jax.random.split(jax.random.key(4), 5)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    k_cache = jax.random.normal(ks[1], (b, S, kh, d), jnp.float32)
+    v_cache = jax.random.normal(ks[2], (b, S, kh, d), jnp.float32)
+    k_new = jax.random.normal(ks[3], (b, 1, kh, d), jnp.float32)
+    v_new = jax.random.normal(ks[4], (b, 1, kh, d), jnp.float32)
+
+    got = _append_free_attention(q, k_cache, v_cache, k_new, v_new, lens)
+    for i in range(b):
+        solo = _append_free_attention(
+            q[i : i + 1], k_cache[i : i + 1], v_cache[i : i + 1],
+            k_new[i : i + 1], v_new[i : i + 1], jnp.int32(int(lens[i])),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[i : i + 1]), np.asarray(solo),
+            rtol=1e-6, atol=1e-6, err_msg=f"row {i} len {int(lens[i])}",
+        )
 
 
 def test_append_free_attention_empty_cache():
