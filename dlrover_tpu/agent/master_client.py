@@ -5,6 +5,7 @@ Parity: reference dlrover/python/elastic_agent/master_client.py:51-778
 """
 
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -13,10 +14,22 @@ from dlrover_tpu.common import comm
 from dlrover_tpu.common.comm import Message
 from dlrover_tpu.common.constants import JobConstant, NodeEnv
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
 from dlrover_tpu.rpc.transport import build_master_stub
 
 
 def retry_rpc(func):
+    """Bounded, jittered exponential retry for idempotent control verbs.
+
+    Applied only to verbs that are safe to re-send: gets, and reports
+    whose master-side apply is a no-op the second time (done-reports pop
+    the lease from ``doing``; a re-apply finds nothing — at-most-once
+    effect). Non-idempotent mutations (``kv_store_add``) are deliberately
+    NOT wrapped. The ±30% jitter keeps a fleet of workers whose RPCs all
+    failed together (master restart) from re-synchronizing into retry
+    stampedes.
+    """
+
     def wrapper(self, *args, **kwargs):
         retry = max(
             kwargs.pop("retry", JobConstant.MASTER_CLIENT_DEFAULT_RETRY), 1
@@ -24,7 +37,8 @@ def retry_rpc(func):
         err = None
         for i in range(retry):
             if i > 0:
-                time.sleep(min(2 ** (i - 1), 8))
+                backoff = min(2 ** (i - 1), 8)
+                time.sleep(backoff * (1.0 + random.uniform(-0.3, 0.3)))
             try:
                 return func(self, *args, **kwargs)
             except Exception as e:  # noqa: BLE001 — transport errors vary
@@ -55,6 +69,7 @@ class MasterClient:
     # ---- plumbing ----------------------------------------------------------
 
     def _get(self, request: comm.BaseRequest, timeout: Optional[float] = None):
+        fault_point("rpc.client.get", request=type(request).__name__)
         msg = Message(
             node_id=self._node_id,
             node_type=self._node_type,
@@ -64,6 +79,7 @@ class MasterClient:
         return comm.BaseResponse.deserialize(resp.data)
 
     def _report(self, request: comm.BaseRequest, timeout: Optional[float] = None):
+        fault_point("rpc.client.report", request=type(request).__name__)
         msg = Message(
             node_id=self._node_id,
             node_type=self._node_type,

@@ -33,12 +33,17 @@ class TrainingHangDiagnostician(Diagnostician):
         hang_timeout_s: float = 600.0,
         restart_after_s: float = 1800.0,
         metric_context=None,
+        clock=time.time,
     ):
         self._perf_monitor = perf_monitor
         self._job_manager = job_manager
         self._hang_timeout_s = hang_timeout_s
         self._restart_after_s = restart_after_s
         self._hang_since = 0.0
+        # Injectable clock: escalation thresholds are minutes-scale in
+        # production, and the tests must drive stagnation -> EventAction
+        # -> JobRestartAction without real sleeps.
+        self._clock = clock
         # Optional out-of-band corroboration (common/metric.py): the
         # native daemons' step counters come from a C++ thread, so a
         # worker wedged inside libtpu still reports — a frozen counter
@@ -84,19 +89,19 @@ class TrainingHangDiagnostician(Diagnostician):
             nodes_alive = not self._job_manager.all_running_node_hanged()
         if stagnated and nodes_alive:
             if self._hang_since == 0.0:
-                self._hang_since = time.time()
+                self._hang_since = self._clock()
             return Observation(
                 observation=_HANG_OBSERVATION,
                 extra={
                     "step": str(self._perf_monitor.global_step),
-                    "hang_for_s": f"{time.time() - self._hang_since:.0f}",
+                    "hang_for_s": f"{self._clock() - self._hang_since:.0f}",
                 },
             )
         self._hang_since = 0.0
         return Observation()
 
     def resolve(self, ob: Observation, **kwargs) -> DiagnosisAction:
-        hang_for = time.time() - self._hang_since
+        hang_for = self._clock() - self._hang_since
         if hang_for >= self._restart_after_s:
             self._hang_since = 0.0
             return JobRestartAction(

@@ -1,0 +1,23 @@
+"""Deterministic fault-injection plane.
+
+A process-wide registry of named injection sites (fault points) compiled
+to near-no-ops when disarmed, armed by a seeded :class:`FaultSchedule`
+so every recovery path in the stack (RPC transport, master servicer,
+sharding client, flash checkpoint, elastic trainer, serving engine) can
+be driven through a *reproducible* fault sequence — the substrate of
+``tools/chaos_soak.py`` and the chaos regression tests
+(docs/DESIGN.md §26).
+"""
+
+from dlrover_tpu.fault.registry import (  # noqa: F401
+    KNOWN_POINTS,
+    FaultAction,
+    FaultInjected,
+    FaultRule,
+    FaultSchedule,
+    active_schedule,
+    arm,
+    arm_from_env,
+    disarm,
+    fault_point,
+)

@@ -1,0 +1,342 @@
+"""Fault-point registry + seeded fault schedules.
+
+Design (docs/DESIGN.md §26):
+
+- A **fault point** is a named call site on a production code path:
+  ``fault_point("ckpt.persist.torn_write", path=...)``. Disarmed (the
+  default, and the only state production jobs ever see) the call is one
+  global read and a return — no locks, no allocation.
+- A **FaultSchedule** arms the process: a list of :class:`FaultRule`\\ s,
+  each binding a point (exact name or ``fnmatch`` glob) to an action.
+  Triggers are *deterministic*: a rule fires on the Nth matching hit
+  (per-rule counter), optionally once. Randomness lives in schedule
+  GENERATION (the soak derives rule parameters from a seeded RNG), not
+  in triggering — that is what makes a seed's fault trace reproducible.
+- **Actions**: ``raise`` (``FaultInjected``), ``delay`` (sleep
+  ``delay_s``), ``crash`` (SIGKILL the process — a worker dying
+  mid-step), ``truncate`` (returned to the caller as a directive; the
+  site applies it, e.g. tearing a just-written checkpoint shard).
+- Every *fired* injection is appended to the schedule's trace — and,
+  when ``DLROVER_TPU_FAULT_TRACE`` names a file, appended there with an
+  fsync BEFORE the action executes, so even a ``crash`` action's entry
+  survives the SIGKILL.
+
+Cross-process arming: ``DLROVER_TPU_FAULT_SCHEDULE`` points at a JSON
+file (:meth:`FaultSchedule.to_json` format); a subprocess calls
+:func:`arm_from_env` early in main. The chaos soak uses this to rig its
+worker subprocesses.
+"""
+
+import fnmatch
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+SCHEDULE_ENV = "DLROVER_TPU_FAULT_SCHEDULE"
+TRACE_ENV = "DLROVER_TPU_FAULT_TRACE"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed fault point with action ``raise``."""
+
+    def __init__(self, point: str, rule_id: str = ""):
+        super().__init__(f"injected fault at {point}" +
+                         (f" (rule {rule_id})" if rule_id else ""))
+        self.point = point
+        self.rule_id = rule_id
+
+
+class FaultAction:
+    RAISE = "raise"
+    DELAY = "delay"
+    CRASH = "crash"
+    TRUNCATE = "truncate"
+
+    ALL = (RAISE, DELAY, CRASH, TRUNCATE)
+
+
+# The instrumented sites, greppable in one place. Tests assert every
+# listed point is actually reachable; new instrumentation registers its
+# name here so the taxonomy in docs/DESIGN.md §26 stays honest.
+KNOWN_POINTS: Dict[str, str] = {
+    "rpc.get.drop_reply": (
+        "master servicer: after a get handler ran (state mutated), drop "
+        "the reply — the client sees a transport error, leases/values "
+        "already moved master-side (ctx: request=<request class name>)"
+    ),
+    "rpc.report.drop_reply": (
+        "master servicer: after a report handler ran, drop the reply — "
+        "exercises at-most-once re-apply of done-reports etc."
+    ),
+    "rpc.client.get": (
+        "master client: before a get RPC leaves the worker "
+        "(ctx: request) — delay simulates a slow master, raise a "
+        "dead one"
+    ),
+    "rpc.client.report": (
+        "master client: before a report RPC leaves the worker"
+    ),
+    "shard.dispatch": (
+        "task manager: entry of the batched lease dispatch — delay "
+        "starves the prefetch pipeline"
+    ),
+    "data.prefetch.fetch": (
+        "sharding client: prefetcher about to fetch leases — raise "
+        "drives the transport-failure retry/backoff path"
+    ),
+    "ckpt.persist.torn_write": (
+        "checkpoint storage: a proc shard file just landed — truncate "
+        "tears its tail (torn write at crash), the reader must reject "
+        "it (ctx: path)"
+    ),
+    "ckpt.persist.proc_file": (
+        "checkpoint storage: before a proc shard file is written — "
+        "crash kills the persister mid-step-dir (uncommitted dir), "
+        "raise fails the persist"
+    ),
+    "ckpt.restore.memory": (
+        "checkpoint engine: about to read the shm image — raise "
+        "simulates the host (and its shm) being replaced, forcing the "
+        "storage restore path"
+    ),
+    "agent.worker.crash": (
+        "elastic trainer: a training step just completed — crash is a "
+        "worker SIGKILL mid-step (ctx: step)"
+    ),
+    "serving.step.error": (
+        "serving engine: an iteration is about to run its compiled "
+        "programs — raise simulates a device/XLA error mid-decode"
+    ),
+    "sync.wait": (
+        "sync service: a bounded barrier wait is starting — delay "
+        "pushes it into its timeout path"
+    ),
+}
+
+
+@dataclass
+class FaultRule:
+    """One (point, trigger, action) binding.
+
+    ``nth``: fire on the Nth matching hit (1-based) of this rule's
+    counter; ``every``: after the first firing, fire again every
+    ``every`` hits (0 = governed by ``once``). ``once``: disarm after
+    the first firing. ``match``: equality filter on the fault point's
+    ctx kwargs (a hit only counts when every key matches).
+    """
+
+    point: str
+    action: str = FaultAction.RAISE
+    nth: int = 1
+    once: bool = True
+    every: int = 0
+    delay_s: float = 0.0
+    truncate_bytes: int = 0
+    match: Optional[Dict[str, str]] = None
+    rule_id: str = ""
+    # runtime state (not part of the wire format)
+    hits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.action not in FaultAction.ALL:
+            raise ValueError(f"unknown fault action {self.action!r}")
+        if self.nth < 1:
+            raise ValueError("nth is 1-based; use nth=1 for 'first hit'")
+        if self.every > 0:
+            # A recurring rule that disarms after one firing would
+            # silently contradict its own ``every``.
+            self.once = False
+        if not self.rule_id:
+            self.rule_id = f"{self.point}:{self.action}:n{self.nth}"
+
+    def matches(self, name: str, ctx: Dict) -> bool:
+        if not fnmatch.fnmatchcase(name, self.point):
+            return False
+        if self.match:
+            for key, want in self.match.items():
+                if str(ctx.get(key)) != str(want):
+                    return False
+        return True
+
+    def should_fire(self) -> bool:
+        """Call with the schedule lock held, after incrementing hits."""
+        if self.once and self.fired:
+            return False
+        if self.hits == self.nth:
+            return True
+        if self.every > 0 and self.hits > self.nth:
+            return (self.hits - self.nth) % self.every == 0
+        return False
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d.pop("hits")
+        d.pop("fired")
+        return d
+
+
+class FaultSchedule:
+    """A seeded set of rules + the trace of everything that fired.
+
+    The ``seed`` is carried for provenance/repro (the soak derives rule
+    parameters from it); triggering itself is deterministic counters.
+    """
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0,
+                 label: str = ""):
+        self.rules = list(rules)
+        self.seed = seed
+        self.label = label
+        self.trace: List[Dict] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._trace_path = os.getenv(TRACE_ENV, "")
+
+    # ---- hit path ----------------------------------------------------------
+
+    def hit(self, name: str, ctx: Dict) -> Optional[Dict]:
+        """Evaluate one fault-point hit. Returns a directive dict for
+        caller-applied actions (truncate), None otherwise. May raise
+        FaultInjected, sleep, or SIGKILL the process."""
+        fired_rule = None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(name, ctx):
+                    continue
+                rule.hits += 1
+                if rule.should_fire():
+                    rule.fired += 1
+                    fired_rule = rule
+                    # Entry built UNDER the lock: a concurrent hit on
+                    # the same rule must not bump ``hits`` between the
+                    # firing decision and its record, and seq order
+                    # must match append order in the in-memory trace.
+                    self._seq += 1
+                    entry = {
+                        "seq": self._seq,
+                        "point": name,
+                        "action": rule.action,
+                        "rule_id": rule.rule_id,
+                        "hit": rule.hits,
+                        "pid": os.getpid(),
+                    }
+                    self.trace.append(entry)
+                    break  # first matching rule wins this hit
+        if fired_rule is None:
+            return None
+        self._record(entry)
+        return self._execute(fired_rule, name, entry)
+
+    def _record(self, entry: Dict):
+        logger.warning(
+            "fault injected: %s action=%s rule=%s hit=%d",
+            entry["point"], entry["action"], entry["rule_id"], entry["hit"],
+        )
+        # Persist BEFORE acting: a crash action must not lose its entry.
+        if self._trace_path:
+            try:
+                with open(self._trace_path, "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                pass
+
+    def _execute(self, rule: FaultRule, name: str, entry: Dict):
+        if rule.action == FaultAction.DELAY:
+            time.sleep(rule.delay_s)
+            return None
+        if rule.action == FaultAction.RAISE:
+            raise FaultInjected(name, rule.rule_id)
+        if rule.action == FaultAction.CRASH:
+            os.kill(os.getpid(), signal.SIGKILL)
+            # Unreachable except in exotic test rigs that block SIGKILL
+            # delivery semantics; fall through to a hard exit.
+            os._exit(137)
+        if rule.action == FaultAction.TRUNCATE:
+            return {
+                "action": FaultAction.TRUNCATE,
+                "truncate_bytes": rule.truncate_bytes,
+                "rule_id": rule.rule_id,
+            }
+        return None
+
+    # ---- wire format -------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "label": self.label,
+            "rules": [r.to_dict() for r in self.rules],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        data = json.loads(text)
+        rules = [FaultRule(**r) for r in data.get("rules", [])]
+        return cls(rules, seed=data.get("seed", 0),
+                   label=data.get("label", ""))
+
+
+# ---------------------------------------------------------------------------
+# Process-wide arming
+# ---------------------------------------------------------------------------
+
+_armed: Optional[FaultSchedule] = None
+_arm_lock = threading.Lock()
+
+
+def fault_point(name: str, **ctx) -> Optional[Dict]:
+    """THE injection site call. Disarmed: one global read, return None.
+
+    Armed: may raise :class:`FaultInjected`, sleep, SIGKILL the process,
+    or return a directive dict (``truncate``) the caller applies.
+    """
+    sched = _armed
+    if sched is None:
+        return None
+    return sched.hit(name, ctx)
+
+
+def arm(schedule: FaultSchedule) -> FaultSchedule:
+    global _armed
+    with _arm_lock:
+        _armed = schedule
+    logger.warning(
+        "fault schedule armed: seed=%d label=%s rules=%d",
+        schedule.seed, schedule.label, len(schedule.rules),
+    )
+    return schedule
+
+
+def disarm():
+    global _armed
+    with _arm_lock:
+        _armed = None
+
+
+def active_schedule() -> Optional[FaultSchedule]:
+    return _armed
+
+
+def arm_from_env() -> Optional[FaultSchedule]:
+    """Arm from the JSON file named by ``DLROVER_TPU_FAULT_SCHEDULE``
+    (subprocess rigging). No-op when unset/unreadable — a worker must
+    never die because its chaos rigging file vanished."""
+    path = os.getenv(SCHEDULE_ENV, "")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            schedule = FaultSchedule.from_json(f.read())
+    except (OSError, ValueError, TypeError) as e:
+        logger.warning("fault schedule %s unusable: %s", path, e)
+        return None
+    return arm(schedule)

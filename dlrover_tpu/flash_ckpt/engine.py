@@ -17,7 +17,9 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.env_utils import get_env_int
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import FaultInjected, fault_point
 from dlrover_tpu.flash_ckpt import storage as ckpt_storage
 from dlrover_tpu.flash_ckpt.shared_obj import (
     SharedLockClient,
@@ -128,6 +130,15 @@ class CheckpointEngine:
         self._restore_bytes = registry.counter(
             "flash_ckpt_restore_bytes_total",
             "bytes materialized by storage restores",
+        )
+        self._restore_rejected = registry.counter(
+            "flash_ckpt_restore_steps_rejected_total",
+            "checkpoint steps rejected at restore (torn/corrupt shards)",
+        )
+        # How many earlier step dirs a restore may fall back through
+        # when the newest is corrupt; retention keeps ~max_to_keep dirs.
+        self._restore_fallback_steps = get_env_int(
+            "DLROVER_TPU_CKPT_RESTORE_FALLBACK_STEPS", 3
         )
 
     # ---- save --------------------------------------------------------------
@@ -456,6 +467,13 @@ class CheckpointEngine:
         return result
 
     def _load_from_memory(self, step: Optional[int] = None):
+        try:
+            fault_point("ckpt.restore.memory", step=step)
+        except FaultInjected:
+            # Chaos: the host (and its shm) was replaced — there is no
+            # memory image to restore; storage must carry the recovery.
+            logger.warning("chaos: shm image treated as lost")
+            return None
         mem_step = self._shm.get_step()
         if mem_step < 0 or (step is not None and mem_step != step):
             return None
@@ -483,25 +501,54 @@ class CheckpointEngine:
     def _load_from_storage(
         self, step: Optional[int] = None, sharding_tree=None
     ):
+        """Restore the requested (or tracker) step; when that step's
+        shard files are torn/corrupt/incomplete AND no explicit step was
+        demanded, fall back to the newest earlier step dir that still
+        restores — a torn write must cost one checkpoint interval, not
+        the job (docs/DESIGN.md §26 invariant 2). Explicit ``step``
+        requests never silently substitute a different step."""
         target = step
         if target is None:
             target = ckpt_storage.read_tracker(self.checkpoint_dir)
         if target < 0:
             return None
-        metas = ckpt_storage.load_step_meta(self.checkpoint_dir, target)
-        if not metas:
-            return None
-        start = time.time()
-        result = load_global_state(
-            self.checkpoint_dir, target, metas, sharding_tree
-        )
-        if result is not None:
+        candidates = [target]
+        if step is None:
+            candidates += [
+                s
+                for s in sorted(
+                    ckpt_storage.list_step_dirs(self.checkpoint_dir),
+                    reverse=True,
+                )
+                if s < target
+            ][: self._restore_fallback_steps]
+        for i, cand in enumerate(candidates):
+            metas = ckpt_storage.load_step_meta(self.checkpoint_dir, cand)
+            if not metas:
+                continue
+            start = time.time()
+            result = load_global_state(
+                self.checkpoint_dir, cand, metas, sharding_tree
+            )
+            if result is None:
+                self._restore_rejected.inc()
+                logger.error(
+                    "checkpoint step %d is not restorable (torn/corrupt/"
+                    "incomplete shards); trying an earlier step", cand
+                )
+                continue
+            if i > 0:
+                logger.warning(
+                    "restored FALLBACK step %d (newest step %d was "
+                    "unrestorable)", cand, target
+                )
             elapsed = max(time.time() - start, 1e-9)
             nbytes = _state_local_nbytes(result[1])
             self._restore_hist.observe(elapsed)
             self._restore_bytes.inc(nbytes)
             self._restore_bw_hist.observe(nbytes / 1e6 / elapsed)
-        return result
+            return result
+        return None
 
     def _is_foreign_image(self, meta: dict) -> bool:
         stamped = meta.get("ckpt_dir")

@@ -31,6 +31,7 @@ import numpy as np
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.env_utils import get_env_int
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import FaultAction, fault_point
 from dlrover_tpu.flash_ckpt.raw_format import (
     RAW_SUFFIX,
     RawShardReader,
@@ -167,10 +168,27 @@ def write_tracker(checkpoint_dir: str, step: int):
         raise
 
 
+def _tear_file(path: str, nbytes: int):
+    """Chaos: chop ``nbytes`` off a just-landed shard file, simulating a
+    write torn by a crash/power cut after the rename. The reader's
+    open-time length/checksum validation must reject the file."""
+    size = os.path.getsize(path)
+    keep = max(size - max(nbytes, 1), 0)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+        f.flush()
+        os.fsync(f.fileno())
+    logger.warning(
+        "chaos: tore %d bytes off %s (%d -> %d)", size - keep, path,
+        size, keep,
+    )
+
+
 def _persist_one_proc(sdir: str, step: int, process_id: int, payload: dict,
                       fmt: str):
     """Write one process's shard + meta files (tmp + rename, one fsync
     per file). Runs on a persist-pool thread."""
+    fault_point("ckpt.persist.proc_file", step=step, process_id=process_id)
     if fmt == NPZ_FORMAT:
         # Legacy writer: kept for the A/B bench and compat tests only.
         npz_tmp = os.path.join(sdir, f".proc-{process_id}.npz.tmp")
@@ -187,9 +205,14 @@ def _persist_one_proc(sdir: str, step: int, process_id: int, payload: dict,
         write_raw_shards(
             raw_tmp, step, process_id, payload["arrays"], bounds
         )
-        os.replace(
-            raw_tmp, os.path.join(sdir, f"proc-{process_id}{RAW_SUFFIX}")
+        raw_final = os.path.join(sdir, f"proc-{process_id}{RAW_SUFFIX}")
+        os.replace(raw_tmp, raw_final)
+        directive = fault_point(
+            "ckpt.persist.torn_write",
+            step=step, process_id=process_id, path=raw_final,
         )
+        if directive and directive.get("action") == FaultAction.TRUNCATE:
+            _tear_file(raw_final, directive.get("truncate_bytes", 64))
     meta_tmp = os.path.join(sdir, f".proc-{process_id}.meta.tmp")
     with open(meta_tmp, "wb") as f:
         pickle.dump(payload["meta"], f)

@@ -9,12 +9,28 @@ import threading
 import time
 from typing import Dict, List
 
+# Blocking-wait audit (ISSUE 5 satellite): ``wait`` is the only
+# blocking surface; its default is bounded and every expiry ticks
+# ``kv_wait_expired_total`` so a key that never arrives is a metric,
+# not a silent hang.
+DEFAULT_WAIT_TIMEOUT_S = 300.0
+
+
+def _kv_metrics():
+    from dlrover_tpu.observability.registry import default_registry
+
+    return default_registry().counter(
+        "kv_wait_expired_total",
+        "bounded KV-store waits that expired before all keys arrived",
+    )
+
 
 class KVStoreService:
     def __init__(self):
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self._wait_expired = _kv_metrics()
 
     def set(self, key: str, value: bytes):
         with self._cond:
@@ -37,12 +53,15 @@ class KVStoreService:
         with self._lock:
             return {k: self._store[k] for k in keys if k in self._store}
 
-    def wait(self, keys: List[str], timeout: float = 300.0) -> bool:
-        deadline = time.time() + timeout
+    def wait(
+        self, keys: List[str], timeout: float = DEFAULT_WAIT_TIMEOUT_S
+    ) -> bool:
+        deadline = time.time() + max(timeout, 0.0)
         with self._cond:
             while not all(k in self._store for k in keys):
                 remaining = deadline - time.time()
                 if remaining <= 0:
+                    self._wait_expired.inc()
                     return False
                 self._cond.wait(remaining)
             return True

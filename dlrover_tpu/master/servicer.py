@@ -24,6 +24,7 @@ from dlrover_tpu.master.elastic_training.rdzv_manager import (
     RendezvousManager,
 )
 from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.fault import fault_point
 from dlrover_tpu.rpc.transport import MasterService
 
 
@@ -132,6 +133,13 @@ class MasterServicer(MasterService):
             )
         else:
             response = handler(message, request)
+        # AFTER the handler: any state mutation (lease moved to doing,
+        # kv value read) already happened — dropping the reply here is
+        # the "response lost on the wire" fault the client-side retry
+        # and the master's timeout recovery must absorb.
+        fault_point(
+            "rpc.get.drop_reply", request=type(request).__name__
+        )
         return Message(node_id=message.node_id, data=response.serialize())
 
     def report(self, message: Message) -> Message:
@@ -148,6 +156,12 @@ class MasterServicer(MasterService):
             )
         else:
             response = handler(message, request)
+        # State already applied; a dropped reply makes the client re-send
+        # — report handlers must stay safe to re-apply (at-most-once
+        # effect), which the chaos soak asserts.
+        fault_point(
+            "rpc.report.drop_reply", request=type(request).__name__
+        )
         return Message(node_id=message.node_id, data=response.serialize())
 
     # ---- rendezvous --------------------------------------------------------

@@ -15,6 +15,7 @@ from typing import Deque, Dict, List, Optional
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
 from dlrover_tpu.master.shard.dataset_splitter import (
     DatasetSplitter,
     Shard,
@@ -311,6 +312,7 @@ class TaskManager:
     ) -> List[comm.ShardTask]:
         """Batched dispatch: up to ``count`` real leases, or a single
         WAIT/invalid sentinel when none are available right now."""
+        fault_point("shard.dispatch", dataset=dataset_name, count=count)
         mgr = self.get_dataset(dataset_name)
         if mgr is None:
             return [comm.ShardTask()]

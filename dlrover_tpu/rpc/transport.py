@@ -30,6 +30,21 @@ REPORT_METHOD = f"/{SERVICE_NAME}/report"
 
 GRPC_MAX_MESSAGE = 512 * 1024 * 1024  # checkpoints metadata can be chunky
 
+# wait_ready is bounded on both stubs (blocking-wait audit, ISSUE 5):
+# the default below caps how long a worker stalls on an absent master,
+# and every expiry ticks a counter so "could not reach the master in
+# time" shows up on /metrics instead of only in scattered caller logs.
+WAIT_READY_TIMEOUT_S = 60.0
+
+
+def _wait_ready_expired_counter():
+    from dlrover_tpu.observability.registry import default_registry
+
+    return default_registry().counter(
+        "rpc_wait_ready_expired_total",
+        "bounded master wait_ready calls that timed out",
+    )
+
 
 class MasterService(abc.ABC):
     """What a master must implement to be served over any transport."""
@@ -122,11 +137,12 @@ class GrpcMasterStub:
         )
         return Message.deserialize(data)
 
-    def wait_ready(self, timeout: float = 60.0) -> bool:
+    def wait_ready(self, timeout: float = WAIT_READY_TIMEOUT_S) -> bool:
         try:
             grpc.channel_ready_future(self._channel).result(timeout=timeout)
             return True
         except grpc.FutureTimeoutError:
+            _wait_ready_expired_counter().inc()
             return False
 
     def close(self):
@@ -269,7 +285,7 @@ class HttpMasterStub:
     def report(self, message: Message, timeout=None) -> Message:
         return self._call("/report", message, timeout)
 
-    def wait_ready(self, timeout: float = 60.0) -> bool:
+    def wait_ready(self, timeout: float = WAIT_READY_TIMEOUT_S) -> bool:
         deadline = time.time() + timeout
         while time.time() < deadline:
             try:
@@ -277,6 +293,7 @@ class HttpMasterStub:
                 return True
             except Exception:
                 time.sleep(0.5)
+        _wait_ready_expired_counter().inc()
         return False
 
     def close(self):

@@ -47,6 +47,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
 from dlrover_tpu.models import generate as gen_lib
 from dlrover_tpu.models import llama
 from dlrover_tpu.serving import scheduler as sched_lib
@@ -192,6 +194,7 @@ class ServingEngine:
         drain_mode: bool = False,
         rng: Optional[jax.Array] = None,
         registry=None,
+        max_requeues: int = 3,
     ):
         if config.pp_stages > 1:
             raise NotImplementedError(
@@ -217,6 +220,10 @@ class ServingEngine:
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
+        # How many step-error restarts a request gets before it is
+        # EXPLICITLY failed — a persistent device error must not
+        # livelock the serve loop re-queueing the same work forever.
+        self.max_requeues = max_requeues
         self.scheduler = Scheduler(
             slots, max_len, prefill_chunk, token_budget, drain_mode
         )
@@ -311,17 +318,26 @@ class ServingEngine:
             self._lengths[req.slot] = 0
             self._tokens[req.slot] = 0
             self._temps[req.slot] = req.temperature
-            self.metrics.requests.inc(outcome="admitted")
+            if req.requeues == 0:
+                # Re-admission after a step-error requeue is not a new
+                # request: counting it again would skew done/admitted
+                # completion-rate dashboards.
+                self.metrics.requests.inc(outcome="admitted")
             self.metrics.annotate(
                 "serving_admit", rid=req.rid, slot=req.slot,
-                prompt_len=req.prompt_len,
+                prompt_len=req.prompt_len, requeues=req.requeues,
             )
-        pf = sch.pick_prefill()
-        if pf is not None:
-            self._run_prefill_chunk(pf, finished)
-        decoding = sch.decoding()
-        if decoding:
-            self._run_decode(decoding, finished)
+        try:
+            fault_point("serving.step.error", step_idx=self._step_idx)
+            pf = sch.pick_prefill()
+            if pf is not None:
+                self._run_prefill_chunk(pf, finished)
+            decoding = sch.decoding()
+            if decoding:
+                self._run_decode(decoding, finished)
+        except Exception as e:  # noqa: BLE001 — device/XLA errors vary
+            self._recover_from_step_error(e, finished)
+            decoding = []
         self._step_idx += 1
         self.metrics.iterations.inc()
         self.metrics.queue_depth.set(len(sch.queue))
@@ -346,6 +362,48 @@ class ServingEngine:
 
     # ---- internals ---------------------------------------------------------
 
+    def _recover_from_step_error(self, err: BaseException,
+                                 finished: List[Request]):
+        """A compiled step raised (device fault, XLA error, injected
+        chaos). The donated KV slabs may have been invalidated by the
+        failed call, so NOTHING cached on device survives: rebuild the
+        pool and return every in-flight request to the front of the
+        queue to restart from scratch. A request that keeps landing in
+        a raising step is EXPLICITLY failed after ``max_requeues``
+        restarts — admitted work is never silently lost, and a
+        persistent error cannot livelock the serve loop. Failed
+        requests surface through ``finished`` with ``failed=True``."""
+        requeued = self.scheduler.requeue_active()
+        self._k, self._v = self._fresh_pool()
+        self._lengths[:] = 0
+        self._tokens[:] = 0
+        self._temps[:] = 0.0
+        self.metrics.step_errors.inc()
+        failed = 0
+        for req in requeued:
+            if req.requeues > self.max_requeues:
+                try:
+                    self.scheduler.queue.remove(req)
+                except ValueError:
+                    pass
+                req.failed = True
+                self.scheduler.finish(req)
+                finished.append(req)
+                failed += 1
+                self.metrics.requests.inc(outcome="failed")
+            else:
+                self.metrics.requests.inc(outcome="requeued")
+        self.metrics.annotate(
+            "serving_step_error",
+            error=f"{type(err).__name__}: {err}"[:200],
+            requeued=len(requeued) - failed, failed=failed,
+        )
+        logger.warning(
+            "serving step raised (%s: %s); pool rebuilt, %d in-flight "
+            "request(s) re-queued, %d explicitly failed",
+            type(err).__name__, err, len(requeued) - failed, failed,
+        )
+
     def _run_prefill_chunk(self, req: Request, finished: List[Request]):
         c = self.prefill_chunk
         start = req.prefill_pos
@@ -365,7 +423,10 @@ class ServingEngine:
             return  # more chunks to come; `first` is discarded unfetched
         tok = int(jax.device_get(first))
         req.first_token_ts = time.monotonic()
-        self.metrics.ttft.observe(req.ttft_s)
+        if req.requeues == 0:
+            # A re-run after a step-error requeue would re-observe an
+            # inflated first-token latency for the same request.
+            self.metrics.ttft.observe(req.ttft_s)
         req.tokens.append(tok)
         self._tokens[req.slot] = tok
         self.metrics.tokens.inc(kind="decode")

@@ -58,6 +58,11 @@ class ServingMetrics:
             "serving_retraces_total",
             "step-program traces (must stay flat after warmup)",
         )
+        self.step_errors = reg.counter(
+            "serving_step_errors_total",
+            "engine iterations that raised and re-queued their in-flight "
+            "requests",
+        )
         self.ttft = reg.histogram(
             "serving_ttft_seconds",
             "submit-to-first-token latency",

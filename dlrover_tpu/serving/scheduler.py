@@ -47,6 +47,8 @@ class Request:
     prefill_pos: int = 0               # prompt rows already in the cache
     tokens: List[int] = field(default_factory=list)
     truncated: bool = False            # hit max_len before max_new_tokens
+    failed: bool = False               # explicitly failed (requeue budget)
+    requeues: int = 0                  # step-error restarts of this request
     submit_ts: float = 0.0
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None
@@ -174,3 +176,28 @@ class Scheduler:
         """Drop a live request (cancellation). Identical bookkeeping to
         finish(); split so callers/metrics can tell outcomes apart."""
         self.finish(req, now)
+
+    # ---- failure recovery --------------------------------------------------
+
+    def requeue_active(self) -> List[Request]:
+        """Return every in-slot request to the FRONT of the queue with
+        its progress reset — the engine calls this when a step raises
+        and the KV pool can no longer be trusted (donated buffers may be
+        invalidated by the failed call). Requests restart from scratch:
+        their sampled tokens depended on cache state that is gone.
+        Queue order preserves rid order (oldest first) so recovery does
+        not reorder service. Returns the re-queued requests."""
+        victims = sorted(self.active(), key=lambda r: r.rid)
+        for req in reversed(victims):
+            if req.slot >= 0:
+                self.by_slot[req.slot] = None
+                self._free.append(req.slot)
+                req.slot = -1
+            req.state = QUEUED
+            req.prefill_pos = 0
+            req.tokens = []
+            req.truncated = False
+            req.first_token_ts = None
+            req.requeues += 1
+            self.queue.appendleft(req)
+        return victims

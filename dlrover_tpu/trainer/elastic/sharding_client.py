@@ -31,6 +31,7 @@ from typing import Iterator, List, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
 
 # End-of-dataset sentinel in the prefetch queue (left in the queue so
 # every later fetch also sees it).
@@ -160,6 +161,9 @@ class ShardingClient:
             # lets the master retire shards before handing out new ones.
             self._flush_if_due()
             try:
+                fault_point(
+                    "data.prefetch.fetch", dataset=self.dataset_name
+                )
                 tasks, wait = self._client.get_tasks(
                     self.dataset_name, self._fetch_batch
                 )

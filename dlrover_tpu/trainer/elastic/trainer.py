@@ -13,6 +13,7 @@ import time
 from dataclasses import dataclass
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
 
 
 @dataclass
@@ -97,6 +98,11 @@ class ElasticTrainer:
         ckpt_block_s: float = 0.0,
     ):
         self.global_step += steps
+        # Chaos site: "mid-step" from the job's perspective — the step
+        # landed on device but nothing downstream (reports, checkpoints
+        # of this step) has run. A crash action here is the worker
+        # SIGKILL the soak's recovery invariants are proved against.
+        fault_point("agent.worker.crash", step=self.global_step)
         now = time.time()
         if self._flight_recorder is not None:
             # Host-side bookkeeping between steps — nothing here touches

@@ -28,3 +28,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running; excluded from the tier-1 run"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: exercises injected-fault recovery paths (fault plane, "
+        "probe rigging)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "soak: seeded chaos-soak episodes through the whole stack; "
+        "pair with slow for the CI slow lane",
+    )

@@ -1,0 +1,555 @@
+"""Deterministic fault-injection plane: registry semantics, instrumented
+sites, and the recovery behaviors the chaos soak leans on — torn-shard
+restore fallback, serving step-error re-queue, bounded waits with expiry
+metrics, the hang diagnostician's escalation, and the node-check probe
+rigging parser (docs/DESIGN.md §26)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.fault import (
+    KNOWN_POINTS,
+    FaultInjected,
+    FaultRule,
+    FaultSchedule,
+    arm,
+    arm_from_env,
+    disarm,
+    fault_point,
+)
+from dlrover_tpu.fault.registry import SCHEDULE_ENV, TRACE_ENV
+
+
+@pytest.fixture(autouse=True)
+def always_disarm():
+    yield
+    disarm()
+
+
+# ---- registry semantics -----------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_disarmed_fault_point_is_noop():
+    disarm()
+    assert fault_point("rpc.get.drop_reply", request="X") is None
+
+
+@pytest.mark.chaos
+def test_nth_hit_and_once():
+    arm(FaultSchedule([FaultRule("a.b", nth=3)], seed=7))
+    assert fault_point("a.b") is None
+    assert fault_point("a.b") is None
+    with pytest.raises(FaultInjected):
+        fault_point("a.b")
+    # once=True: disarmed after firing.
+    assert fault_point("a.b") is None
+
+
+@pytest.mark.chaos
+def test_every_refires():
+    arm(FaultSchedule(
+        [FaultRule("p", nth=2, once=False, every=2)], seed=0
+    ))
+    fired = 0
+    for _ in range(8):
+        try:
+            fault_point("p")
+        except FaultInjected:
+            fired += 1
+    assert fired == 4  # hits 2, 4, 6, 8
+
+
+@pytest.mark.chaos
+def test_glob_and_ctx_match():
+    arm(FaultSchedule([
+        FaultRule("rpc.*.drop_reply", match={"request": "TaskRequest"}),
+    ], seed=0))
+    # Wrong ctx: not even counted as a hit.
+    assert fault_point("rpc.get.drop_reply", request="Other") is None
+    with pytest.raises(FaultInjected):
+        fault_point("rpc.get.drop_reply", request="TaskRequest")
+
+
+@pytest.mark.chaos
+def test_delay_and_truncate_directive():
+    arm(FaultSchedule([
+        FaultRule("slow", action="delay", delay_s=0.05),
+        FaultRule("tear", action="truncate", truncate_bytes=9),
+    ], seed=0))
+    t0 = time.monotonic()
+    assert fault_point("slow") is None
+    assert time.monotonic() - t0 >= 0.05
+    directive = fault_point("tear", path="x")
+    assert directive["action"] == "truncate"
+    assert directive["truncate_bytes"] == 9
+
+
+@pytest.mark.chaos
+def test_trace_records_before_action(tmp_path, monkeypatch):
+    trace_file = tmp_path / "trace.jsonl"
+    monkeypatch.setenv(TRACE_ENV, str(trace_file))
+    sched = FaultSchedule([FaultRule("boom")], seed=3)
+    arm(sched)
+    with pytest.raises(FaultInjected):
+        fault_point("boom")
+    assert sched.trace[0]["point"] == "boom"
+    on_disk = [json.loads(l) for l in trace_file.read_text().splitlines()]
+    assert on_disk[0]["rule_id"] == sched.trace[0]["rule_id"]
+
+
+@pytest.mark.chaos
+def test_schedule_json_roundtrip_and_env_arm(tmp_path, monkeypatch):
+    sched = FaultSchedule([
+        FaultRule("x", action="delay", delay_s=1.5, nth=2,
+                  match={"k": "v"}),
+    ], seed=11, label="ep0")
+    path = tmp_path / "sched.json"
+    path.write_text(sched.to_json())
+    monkeypatch.setenv(SCHEDULE_ENV, str(path))
+    armed = arm_from_env()
+    assert armed is not None
+    assert armed.seed == 11 and armed.label == "ep0"
+    assert armed.rules[0].delay_s == 1.5
+    assert armed.rules[0].match == {"k": "v"}
+    # Unreadable file must not kill the process.
+    monkeypatch.setenv(SCHEDULE_ENV, str(tmp_path / "missing.json"))
+    assert arm_from_env() is None
+
+
+@pytest.mark.chaos
+def test_bad_rule_rejected():
+    with pytest.raises(ValueError):
+        FaultRule("x", action="explode")
+    with pytest.raises(ValueError):
+        FaultRule("x", nth=0)
+
+
+@pytest.mark.chaos
+def test_every_known_point_is_instrumented():
+    """The taxonomy must not drift from the code: every KNOWN_POINTS
+    name appears as a ``fault_point("<name>"`` call site in the package
+    (the fault package itself doesn't count — it only documents)."""
+    import re
+
+    import dlrover_tpu
+
+    root = os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
+    blob = []
+    for dirpath, _, files in os.walk(root):
+        if os.path.basename(dirpath) == "fault":
+            continue
+        for name in files:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name)) as f:
+                    blob.append(f.read())
+    blob = "\n".join(blob)
+    missing = [
+        p for p in KNOWN_POINTS
+        if not re.search(
+            r"fault_point\(\s*" + re.escape(f'"{p}"'), blob
+        )
+    ]
+    assert not missing, f"documented but uninstrumented points: {missing}"
+
+
+# ---- servicer: dropped replies ---------------------------------------------
+
+
+@pytest.mark.chaos
+def test_dropped_get_task_reply_leaves_lease_recoverable():
+    """Dropping the reply AFTER dispatch leaves the lease in ``doing``;
+    timeout recovery re-queues it — no shard is lost."""
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.common.comm import Message
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.shard.task_manager import TaskManager
+
+    tm = TaskManager(task_timeout=0.05)
+    tm.new_dataset(comm.DatasetShardParams(
+        dataset_name="d", dataset_size=32, shard_size=16, num_epochs=1,
+    ))
+    servicer = MasterServicer(rdzv_managers={}, task_manager=tm)
+    arm(FaultSchedule([
+        FaultRule("rpc.get.drop_reply",
+                  match={"request": "MultiTaskRequest"}),
+    ], seed=0))
+    req = comm.MultiTaskRequest(dataset_name="d", node_id=0, count=1)
+    msg = Message(node_id=0, data=req.serialize())
+    with pytest.raises(FaultInjected):
+        servicer.get(msg)
+    mgr = tm.get_dataset("d")
+    assert len(mgr.doing) == 1  # dispatched, reply lost
+    time.sleep(0.06)
+    mgr.recover_timeout_tasks(0.05)
+    assert len(mgr.doing) == 0 and len(mgr.todo) == 2
+    disarm()
+    # Both shards still dispatchable exactly once each.
+    resp = comm.BaseResponse.deserialize(servicer.get(msg).data)
+    assert len(resp.tasks) == 1
+
+
+@pytest.mark.chaos
+def test_done_report_reapply_is_at_most_once():
+    """A re-sent done-report (reply dropped) must not double-count."""
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.shard.task_manager import TaskManager
+
+    tm = TaskManager(task_timeout=60)
+    tm.new_dataset(comm.DatasetShardParams(
+        dataset_name="d", dataset_size=16, shard_size=16, num_epochs=1,
+    ))
+    tasks = tm.get_tasks(0, "d", 1)
+    tid = tasks[0].task_id
+    tm.report_tasks_done("d", 0, [tid], [])
+    tm.report_tasks_done("d", 0, [tid], [])  # client retry after drop
+    mgr = tm.get_dataset("d")
+    assert mgr.checkpoint()["completed"] == 1
+
+
+# ---- checkpoint: torn shard rejection + fallback restore -------------------
+
+
+@pytest.mark.chaos
+def test_torn_shard_rejected_and_previous_step_restored(
+    tmp_path, monkeypatch
+):
+    from dlrover_tpu.flash_ckpt import storage as ckpt_storage
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+    from dlrover_tpu.flash_ckpt.raw_format import RAW_SUFFIX
+
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "torn-test")
+    ckpt_dir = str(tmp_path / "ckpt")
+    engine = CheckpointEngine(ckpt_dir, standalone=True)
+    try:
+        s1 = {"a": np.arange(4096, dtype=np.int64)}
+        s2 = {"a": np.arange(4096, dtype=np.int64) * 2}
+        engine.save_to_storage(1, s1, user_meta={"tag": "one"})
+        engine.save_to_storage(2, s2, user_meta={"tag": "two"})
+        assert ckpt_storage.read_tracker(ckpt_dir) == 2
+        # Tear the newest step's shard file past its data region.
+        raw = os.path.join(
+            ckpt_storage.step_dir(ckpt_dir, 2), f"proc-0{RAW_SUFFIX}"
+        )
+        size = os.path.getsize(raw)
+        with open(raw, "r+b") as f:
+            f.truncate(size - 8192)
+        # Storage restore must reject step 2 and fall back to step 1.
+        result = engine._load_from_storage(None, None)  # noqa: SLF001
+        assert result is not None
+        step, state, meta = result
+        assert step == 1 and meta["tag"] == "one"
+        np.testing.assert_array_equal(state["a"], s1["a"])
+        # An EXPLICIT step request never substitutes a different step.
+        assert engine._load_from_storage(2, None) is None  # noqa: SLF001
+    finally:
+        engine.close()
+
+
+@pytest.mark.chaos
+def test_restore_memory_fault_forces_storage(tmp_path, monkeypatch):
+    from dlrover_tpu.flash_ckpt.engine import CheckpointEngine
+
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "shm-lost-test")
+    engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+    try:
+        state = {"a": np.arange(16, dtype=np.int64)}
+        engine.save_to_storage(3, state)
+        arm(FaultSchedule(
+            [FaultRule("ckpt.restore.memory")], seed=0
+        ))
+        result = engine.load()
+        # The shm image was declared lost; storage still restores.
+        assert result is not None and result[0] == 3
+    finally:
+        engine.close()
+
+
+# ---- serving: step error re-queues in-flight requests ----------------------
+
+
+@pytest.mark.chaos
+def test_scheduler_requeue_active_resets_and_preserves_order():
+    from dlrover_tpu.serving.scheduler import QUEUED, Scheduler
+
+    sch = Scheduler(slots=2, max_len=32, prefill_chunk=8)
+    r0 = sch.submit([1, 2, 3], max_new_tokens=4)
+    r1 = sch.submit([4, 5], max_new_tokens=4)
+    sch.admit()
+    r0.tokens = [7]
+    r0.prefill_pos = 3
+    victims = sch.requeue_active()
+    assert {v.rid for v in victims} == {r0.rid, r1.rid}
+    assert [r.rid for r in sch.queue] == [r0.rid, r1.rid]
+    assert r0.state == QUEUED and r0.tokens == [] and r0.prefill_pos == 0
+    assert all(s is None for s in sch.by_slot)
+    assert len(sch._free) == 2
+
+
+@pytest.mark.chaos
+def test_serving_step_error_requeues_and_completes():
+    """An engine step that raises mid-flight must re-queue its admitted
+    requests and finish them after recovery — no request lost, tokens
+    fully populated."""
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving import scheduler as sched_lib
+    from dlrover_tpu.serving.engine import ServingEngine
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(cfg, params, slots=2, max_len=64,
+                        prefill_chunk=8)
+    eng.warmup()
+    reqs = [
+        eng.submit([5, 6, 7], max_new_tokens=3),
+        eng.submit([8, 9], max_new_tokens=3),
+    ]
+    arm(FaultSchedule(
+        [FaultRule("serving.step.error", nth=2)], seed=0
+    ))
+    done = eng.run_until_idle(max_iters=500)
+    assert {r.rid for r in done} == {r.rid for r in reqs}
+    for r in reqs:
+        assert r.state == sched_lib.DONE
+        assert len(r.tokens) == 3
+    assert eng.metrics.step_errors.value() >= 1
+    assert eng.metrics.requests.value(outcome="requeued") >= 1
+
+
+@pytest.mark.chaos
+def test_serving_persistent_step_error_fails_explicitly():
+    """A step that raises EVERY iteration must not livelock the serve
+    loop: after max_requeues restarts each request is explicitly
+    failed (failed=True, surfaced through step()'s return) and the
+    engine drains."""
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving import scheduler as sched_lib
+    from dlrover_tpu.serving.engine import ServingEngine
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(cfg, params, slots=2, max_len=64,
+                        prefill_chunk=8, max_requeues=2)
+    eng.warmup()
+    reqs = [eng.submit([3, 4, 5], max_new_tokens=3)]
+    arm(FaultSchedule(
+        [FaultRule("serving.step.error", nth=1, once=False, every=1)],
+        seed=0,
+    ))
+    done = eng.run_until_idle(max_iters=200)
+    assert [r.rid for r in done] == [reqs[0].rid]
+    assert reqs[0].failed and reqs[0].state == sched_lib.DONE
+    assert eng.pending() == 0
+    assert eng.metrics.requests.value(outcome="failed") >= 1
+
+
+# ---- bounded waits + expiry metrics ----------------------------------------
+
+
+@pytest.mark.chaos
+def test_sync_wait_bounded_with_expiry_metric():
+    from dlrover_tpu.master.elastic_training.sync_service import (
+        SyncService,
+    )
+
+    svc = SyncService()
+    before = svc._wait_expired.value()  # noqa: SLF001
+    t0 = time.monotonic()
+    assert svc.wait_finished("never", timeout=0.05) is False
+    assert time.monotonic() - t0 < 2.0
+    assert svc._wait_expired.value() == before + 1  # noqa: SLF001
+    svc.sync_finished("done")
+    assert svc.wait_finished("done", timeout=0.05) is True
+
+
+@pytest.mark.chaos
+def test_kv_wait_bounded_with_expiry_metric():
+    from dlrover_tpu.master.elastic_training.kv_store import (
+        KVStoreService,
+    )
+
+    kv = KVStoreService()
+    before = kv._wait_expired.value()  # noqa: SLF001
+    assert kv.wait(["missing"], timeout=0.05) is False
+    assert kv._wait_expired.value() == before + 1  # noqa: SLF001
+    kv.set("k", b"v")
+    assert kv.wait(["k"], timeout=0.05) is True
+
+
+@pytest.mark.chaos
+def test_http_wait_ready_expiry_metric():
+    from dlrover_tpu.rpc.transport import (
+        HttpMasterStub,
+        _wait_ready_expired_counter,
+    )
+
+    stub = HttpMasterStub("localhost:1", timeout=0.2)
+    before = _wait_ready_expired_counter().value()
+    assert stub.wait_ready(timeout=0.3) is False
+    assert _wait_ready_expired_counter().value() == before + 1
+    stub.close()
+
+
+# ---- hang diagnostician escalation (fake clock) ----------------------------
+
+
+class _FakeClock:
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+class _FakePerfMonitor:
+    """PerfMonitor stand-in driven by the same fake clock: reports the
+    wall age of the last step report instead of real time."""
+
+    def __init__(self, clock: _FakeClock):
+        self._clock = clock
+        self.global_step = 0
+        self._last_report_t = None
+
+    def report_step(self, step: int):
+        self.global_step = step
+        self._last_report_t = self._clock()
+
+    def step_stagnated(self, timeout_secs: float) -> bool:
+        if self._last_report_t is None:
+            return False
+        return (self._clock() - self._last_report_t) > timeout_secs
+
+
+@pytest.mark.chaos
+def test_hang_diagnostician_escalation_with_fake_clock():
+    """step stagnation -> EventAction -> JobRestartAction after
+    restart_after_s, all on a synthetic clock (no sleeps)."""
+    from dlrover_tpu.common.constants import DiagnosisActionType
+    from dlrover_tpu.diagnosis.actions import EventAction, NoAction
+    from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+        TrainingHangDiagnostician,
+    )
+
+    clock = _FakeClock()
+    perf = _FakePerfMonitor(clock)
+    d = TrainingHangDiagnostician(
+        perf, hang_timeout_s=600.0, restart_after_s=1800.0, clock=clock
+    )
+    # No steps yet: healthy.
+    assert isinstance(d.diagnose(), NoAction)
+    perf.report_step(10)
+    clock.advance(300)
+    assert isinstance(d.diagnose(), NoAction)  # within hang_timeout
+    clock.advance(400)  # 700s stagnant: hang suspected, young
+    action = d.diagnose()
+    assert isinstance(action, EventAction)
+    assert "10" in action.event_msg
+    clock.advance(1700)  # hang age 1700s < restart_after: still event
+    assert isinstance(d.diagnose(), EventAction)
+    clock.advance(200)   # hang age 1900s >= restart_after: restart
+    action = d.diagnose()
+    assert action.action_type == DiagnosisActionType.JOB_RESTART
+    assert "step 10" in action.reason
+    # Escalation state resets: progress clears everything.
+    perf.report_step(11)
+    assert isinstance(d.diagnose(), NoAction)
+
+
+@pytest.mark.chaos
+def test_hang_diagnostician_restart_timer_not_reset_by_events():
+    """The restart countdown runs from the FIRST stagnant observation,
+    not from the last emitted event."""
+    from dlrover_tpu.common.constants import DiagnosisActionType
+    from dlrover_tpu.diagnosis.diagnosticians.training_hang import (
+        TrainingHangDiagnostician,
+    )
+
+    clock = _FakeClock()
+    perf = _FakePerfMonitor(clock)
+    d = TrainingHangDiagnostician(
+        perf, hang_timeout_s=10.0, restart_after_s=100.0, clock=clock
+    )
+    perf.report_step(5)
+    clock.advance(20)
+    for _ in range(5):
+        d.diagnose()          # events only
+        clock.advance(10)
+    clock.advance(60)         # total stagnation now 130s
+    action = d.diagnose()
+    assert action.action_type == DiagnosisActionType.JOB_RESTART
+
+
+# ---- node-check probe rigging ----------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_ranks_parser(monkeypatch):
+    from dlrover_tpu.agent.node_check_worker import _chaos_ranks
+
+    monkeypatch.setenv("RIG", "0, 2,junk,,7,-1")
+    assert _chaos_ranks("RIG") == {0, 2, 7, -1}
+    monkeypatch.delenv("RIG")
+    assert _chaos_ranks("RIG") == set()
+
+
+@pytest.mark.chaos
+def test_fail_rank_rigging_exits_without_result(tmp_path, monkeypatch):
+    """A FAIL-rigged rank must exit nonzero and leave NO result file —
+    that absence is exactly what the agent reports as a failed probe,
+    driving the master's bisection (e2e in test_node_check.py)."""
+    import subprocess
+    import sys
+
+    result_file = tmp_path / "probe.out"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DLROVER_TPU_CHECK_NODE_RANK": "1",
+        "DLROVER_TPU_CHAOS_CHECK_FAIL_RANKS": "1,3",
+    })
+    rc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.agent.node_check_worker",
+         str(result_file), "64", "8", "0"],
+        env=env, timeout=120, capture_output=True,
+    ).returncode
+    assert rc == 1
+    assert not result_file.exists()
+
+
+@pytest.mark.chaos
+def test_slow_rank_rigging_straggles_inside_timed_region(
+    tmp_path, monkeypatch
+):
+    """A SLOW-rigged rank still succeeds but its reported elapsed time
+    includes the injected straggle — the signal the master's straggler
+    detection keys on."""
+    import subprocess
+    import sys
+
+    result_file = tmp_path / "probe.out"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DLROVER_TPU_CHECK_NODE_RANK": "2",
+        "DLROVER_TPU_CHAOS_CHECK_SLOW_RANKS": "2",
+        "DLROVER_TPU_CHAOS_CHECK_SLOW_SECS": "1.5",
+    })
+    rc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.agent.node_check_worker",
+         str(result_file), "64", "8", "0"],
+        env=env, timeout=120, capture_output=True,
+    ).returncode
+    assert rc == 0
+    elapsed = float(result_file.read_text())
+    assert elapsed >= 1.5

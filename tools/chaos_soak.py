@@ -1,0 +1,77 @@
+"""Seeded chaos-soak CLI: drive the whole stack through reproducible
+fault episodes and assert the four system invariants.
+
+    python tools/chaos_soak.py --seed 0 --episodes 3
+    python tools/chaos_soak.py --seed 0 --episode 1      # repro one
+
+Each episode runs an in-process master, a crash-restartable worker
+subprocess and a serving engine under a deterministic seeded fault
+schedule (worker SIGKILL mid-step, dropped RPC replies, torn checkpoint
+shard writes, serving step errors, ...). The implementation and the
+invariant definitions live in ``dlrover_tpu/testing/soak.py``
+(docs/DESIGN.md §26); exit code 0 means every episode held every
+invariant. Prints one JSON summary line with goodput fraction and
+per-fault MTTR — the same numbers ``bench.py``'s ``chaos_goodput``
+phase reports.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrover_tpu.testing.soak import (  # noqa: E402
+    SoakConfig,
+    SoakInvariantError,
+    run_soak,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="seeded chaos soak")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--episodes", type=int, default=3)
+    parser.add_argument(
+        "--episode", type=int, default=None,
+        help="run only this episode index (repro mode)",
+    )
+    parser.add_argument("--dataset-size", type=int, default=512)
+    parser.add_argument("--shard-size", type=int, default=16)
+    parser.add_argument("--watchdog-s", type=float, default=180.0)
+    parser.add_argument("--no-serving", action="store_true")
+    parser.add_argument(
+        "--artifact-dir", default=None,
+        help="where failure evidence lands (default: under the work dir)",
+    )
+    parser.add_argument(
+        "--keep-artifacts", action="store_true",
+        help="keep episode dirs even on success",
+    )
+    args = parser.parse_args(argv)
+    cfg = SoakConfig(
+        dataset_size=args.dataset_size,
+        shard_size=args.shard_size,
+        watchdog_s=args.watchdog_s,
+        serve=not args.no_serving,
+        keep_artifacts_on_success=args.keep_artifacts,
+    )
+    try:
+        summary = run_soak(
+            seed=args.seed,
+            episodes=args.episodes,
+            episode=args.episode,
+            cfg=cfg,
+            artifact_dir=args.artifact_dir,
+        )
+    except SoakInvariantError:
+        # run_episode already printed the failure, artifact dir and the
+        # one-line repro command.
+        return 1
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
