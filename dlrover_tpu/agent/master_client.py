@@ -159,6 +159,74 @@ class MasterClient:
         resp = self._get(comm.StragglerRequest())
         return resp.stragglers
 
+    # ---- live rescale ------------------------------------------------------
+
+    @retry_rpc
+    def rescale_join(
+        self,
+        node_rank: int,
+        local_world_size: int = 1,
+        node_group: int = -1,
+    ):
+        """Announce this worker to the rescale plane (idempotent)."""
+        return self._report(
+            comm.RescaleJoinReport(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                node_group=node_group,
+            )
+        )
+
+    @retry_rpc
+    def get_rescale_plan(self, node_rank: int, current_plan_id: int = -1):
+        """Latest rescale plan newer than ``current_plan_id``, or None.
+        Returns the raw RescalePlanResponse (plan_id == -1 -> no plan)."""
+        resp = self._get(
+            comm.RescalePlanRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                current_plan_id=current_plan_id,
+            )
+        )
+        if getattr(resp, "plan_id", -1) < 0:
+            return None
+        return resp
+
+    @retry_rpc
+    def report_rescale_ack(
+        self, node_rank: int, plan_id: int, phase: str
+    ):
+        # Idempotent master-side (set add), so the retry wrapper is safe.
+        return self._report(
+            comm.RescaleAckReport(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                plan_id=plan_id,
+                phase=phase,
+            )
+        )
+
+    @retry_rpc
+    def get_rescale_barrier(
+        self, node_rank: int, plan_id: int, phase: str
+    ):
+        """(ready, expired, superseded, missing) of a plan's phase."""
+        resp = self._get(
+            comm.RescaleBarrierRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                plan_id=plan_id,
+                phase=phase,
+            )
+        )
+        return (
+            getattr(resp, "ready", False),
+            getattr(resp, "expired", False),
+            getattr(resp, "superseded", False),
+            getattr(resp, "missing", []),
+        )
+
     # ---- heartbeat / events ------------------------------------------------
 
     def report_heartbeat(self, timestamp: Optional[float] = None):

@@ -408,6 +408,87 @@ class ShardCheckpointRestoreRequest(BaseRequest):
 
 
 # --------------------------------------------------------------------------
+# Live elastic rescale (plan broadcast + barrier; docs/DESIGN.md §27)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class RescaleJoinReport(BaseRequest):
+    """A worker announcing itself to the rescale plane — at process start
+    (bootstrap / scale-up join) the coordinator folds it into the live
+    set and, mid-run, a join triggers a scale-up plan."""
+
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    # TPU slice/block index (-1 = ungrouped) — lets the coordinator form
+    # worlds from complete blocks only, like rendezvous does.
+    node_group: int = -1
+
+
+@dataclass
+class RescalePlanRequest(BaseRequest):
+    """Poll for a rescale plan newer than ``current_plan_id`` (-1 = any).
+    Pull-based broadcast: the versioned plan is fetched, not pushed, so a
+    dropped reply costs one poll interval, never a lost plan."""
+
+    node_id: int = 0
+    node_rank: int = 0
+    current_plan_id: int = -1
+
+
+@dataclass
+class RescalePlanResponse(BaseResponse):
+    """A versioned rescale plan. ``plan_id`` is -1 when no newer plan
+    exists. ``world`` maps node_rank -> local_world_size for the NEW
+    world; a polling rank absent from ``world`` has been evicted.
+    ``restore_step`` is the last committed checkpoint step every
+    survivor must restore (-1 = fresh/bootstrap)."""
+
+    plan_id: int = -1
+    world: Dict[int, int] = field(default_factory=dict)
+    rank_order: List[int] = field(default_factory=list)
+    restore_step: int = -1
+    reason: str = ""
+    created_at: float = 0.0
+    barrier_timeout_s: float = 30.0
+
+
+@dataclass
+class RescaleAckReport(BaseRequest):
+    """Worker progress through a plan's phases ("barrier": data path
+    torn down, done-reports flushed; "restored": state + shard cursor
+    restored at the plan step; "resumed": first post-rescale step about
+    to run). Idempotent — safe under RPC retry."""
+
+    node_id: int = 0
+    node_rank: int = 0
+    plan_id: int = -1
+    phase: str = "barrier"
+
+
+@dataclass
+class RescaleBarrierRequest(BaseRequest):
+    node_id: int = 0
+    node_rank: int = 0
+    plan_id: int = -1
+    phase: str = "barrier"
+
+
+@dataclass
+class RescaleBarrierResponse(BaseResponse):
+    """``ready``: every rank of the plan's world acked ``phase``.
+    ``superseded``: a newer plan exists — abandon this barrier and poll
+    the plan verb again. ``expired``: the bounded wait ran out; the
+    coordinator has already re-planned around the missing ranks."""
+
+    ready: bool = False
+    expired: bool = False
+    superseded: bool = False
+    missing: List[int] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
 # Checkpoint coordination
 # --------------------------------------------------------------------------
 

@@ -395,15 +395,21 @@ class CheckpointEngine:
         # process counts would be wrong for uneven or non-contiguous
         # worlds.
         expected = list(self._ctx.node_ranks) or [node_rank]
+        # Standalone runs the commit on the TRAINING thread: a dead peer
+        # must cost seconds, not the agent path's 10 minutes. Tunable
+        # because this wait is uninterruptible — a live-rescale worker
+        # whose peer was just killed is blind to the superseding plan
+        # until the commit wait returns, so rescale harnesses cap it.
+        commit_timeout = get_env_int(
+            "DLROVER_TPU_CKPT_COMMIT_TIMEOUT_S", 30
+        )
         return persist_shm_to_storage(
             self.checkpoint_dir,
             step,
             node_rank,
             local_world_size=self._ctx.local_world_size,
             expected_nodes=expected,
-            # Standalone runs the commit on the TRAINING thread: a dead
-            # peer must cost seconds, not the agent path's 10 minutes.
-            commit_timeout=30.0,
+            commit_timeout=float(commit_timeout),
         )
 
     def _wait_local_segments(self, step: int, timeout: float) -> bool:
@@ -979,6 +985,65 @@ def load_global_state(
         return None
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return step, state, user_meta
+
+
+def load_state_regions(
+    checkpoint_dir: str,
+    step: int,
+    regions_by_leaf: Optional[Dict[int, list]] = None,
+):
+    """Explicit-region partial restore (the live-rescale path for hosts
+    that address their shards by byte range rather than a jax sharding).
+
+    ``regions_by_leaf``: leaf_id -> list of closed bounds tuples
+    (``((lo, hi), ...)`` per dim); leaves absent from the map are read
+    in full. Reads ONLY the intersecting byte ranges from the step's
+    mmap'd shard files through the same lazy-reader machinery the
+    sharding-tree restore uses — after an N→M re-mesh each survivor
+    pays O(its new bytes), not O(global state).
+
+    Returns ``(step, leaves, user_meta)`` with
+    ``leaves[leaf_id] = {bounds: np.ndarray}``, or None when the step is
+    missing/torn/not fully covering a requested region.
+    """
+    from dlrover_tpu.flash_ckpt.raw_format import ShardCorruptionError
+
+    metas = ckpt_storage.load_step_meta(checkpoint_dir, step)
+    if not metas:
+        return None
+    first = metas[min(metas)]
+    user_meta = first.get("user_meta", {})
+    leaf_info, locations = _index_shard_locations(metas)
+    regions_by_leaf = regions_by_leaf or {}
+    readers = _LazyReaders(checkpoint_dir, step, metas)
+    leaves: Dict[int, dict] = {}
+    try:
+        for i, info in enumerate(leaf_info):
+            if info is None:
+                return None
+            gshape = info[0]
+            bounds_list = regions_by_leaf.get(i)
+            if bounds_list is None:
+                bounds_list = [tuple((0, d) for d in gshape)]
+            bounds_list = [
+                tuple(tuple(b) for b in bounds) for bounds in bounds_list
+            ]
+            regions = _assemble_leaf_regions(
+                info, locations[i], readers, bounds_list
+            )
+            if regions is None:
+                logger.error(
+                    "step %d leaf %d: requested regions not covered by "
+                    "stored shards", step, i
+                )
+                return None
+            leaves[i] = regions
+    except ShardCorruptionError as e:
+        logger.error("refusing corrupt checkpoint step %d: %s", step, e)
+        return None
+    finally:
+        readers.close_all()
+    return step, leaves, user_meta
 
 
 def to_device_state(np_state, sharding_tree=None):

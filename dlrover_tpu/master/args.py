@@ -60,6 +60,14 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="job global batch (enables micro-batch/accum suggestions)",
     )
     parser.add_argument(
+        "--micro_batch_per_device",
+        type=int,
+        default=0,
+        help="per-device micro batch; with --global_batch_size, "
+        "restricts rendezvous/rescale worlds to dp sizes where "
+        "global_batch %% (micro * dp) == 0",
+    )
+    parser.add_argument(
         "--devices_per_node",
         type=int,
         default=4,

@@ -79,6 +79,7 @@ class DistributedJobMaster:
         legal_worker_counts=None,
         dashboard_port: int = -1,
         global_batch_size: int = 0,
+        micro_batch_per_device: int = 0,
         devices_per_node: int = 4,
         brain_addr: str = "",
         topology_aware: bool = False,
@@ -136,12 +137,39 @@ class DistributedJobMaster:
                 devices_per_node=devices_per_node,
             )
         )
+        from dlrover_tpu.master.elastic_training.rescale_coordinator import (
+            RescaleCoordinator,
+            wire_batch_legality,
+        )
+
+        self.rescale_coordinator = RescaleCoordinator(
+            node_unit=max(node_group_size, 1),
+            bootstrap_min=node_num,
+        )
+        if global_batch_size > 0 and micro_batch_per_device > 0:
+            # Rendezvous and rescale plans only form worlds whose dp
+            # size divides the global batch — otherwise a partial-
+            # survivor world would crash grad_accum_for() on arrival.
+            from dlrover_tpu.trainer.elastic.trainer import (
+                ElasticBatchConfig,
+            )
+
+            wire_batch_legality(
+                self.rdzv_managers,
+                self.rescale_coordinator,
+                ElasticBatchConfig(
+                    global_batch_size=global_batch_size,
+                    micro_batch_per_device=micro_batch_per_device,
+                ),
+                local_world_size=devices_per_node,
+            )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
             job_manager=self.job_manager,
             diagnosis_master=diagnosis_master,
             perf_monitor=self.perf_monitor,
+            rescale_coordinator=self.rescale_coordinator,
         )
         self._server = create_master_server(port, self.servicer, transport)
         self.port = self._server.port
@@ -307,6 +335,9 @@ class DistributedJobMaster:
             legal_worker_counts=legal_counts,
             dashboard_port=getattr(args, "dashboard_port", -1),
             global_batch_size=getattr(args, "global_batch_size", 0),
+            micro_batch_per_device=getattr(
+                args, "micro_batch_per_device", 0
+            ),
             devices_per_node=getattr(args, "devices_per_node", 4),
             brain_addr=getattr(args, "brain_addr", ""),
             metric_endpoints=_parse_metric_endpoints(

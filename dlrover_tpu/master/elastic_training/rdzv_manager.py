@@ -51,6 +51,32 @@ def default_legal_node_counts(max_nodes: int, node_unit: int) -> List[int]:
     return counts or [max_nodes]
 
 
+def _rdzv_metrics():
+    """Rendezvous observability (PR-1 registry, scraped at /metrics):
+    rounds completed, nodes currently waiting, and time-to-quorum per
+    rendezvous domain."""
+    from dlrover_tpu.observability.registry import default_registry
+
+    reg = default_registry()
+    return {
+        "rounds": reg.counter(
+            "rdzv_rounds_total",
+            "completed rendezvous rounds",
+            labelnames=("rdzv",),
+        ),
+        "waiting": reg.gauge(
+            "rdzv_nodes_waiting",
+            "nodes currently waiting in the rendezvous",
+            labelnames=("rdzv",),
+        ),
+        "quorum": reg.histogram(
+            "rdzv_time_to_quorum_seconds",
+            "first join of a round to round completion",
+            labelnames=("rdzv",),
+        ),
+    }
+
+
 class RendezvousManager(ABC):
     """Holds the waiting set and completed rounds for one rendezvous name."""
 
@@ -67,6 +93,7 @@ class RendezvousManager(ABC):
         self._legal_counts_fn: Callable[[int, int], List[int]] = (
             default_legal_node_counts
         )
+        self._metrics = _rdzv_metrics()
 
     # ---- configuration -----------------------------------------------------
 
@@ -106,6 +133,20 @@ class RendezvousManager(ABC):
             # A dead node must not keep a pending round open.
             if node_rank in self._waiting:
                 del self._waiting[node_rank]
+                self._metrics["waiting"].set(
+                    len(self._waiting), rdzv=self.name
+                )
+
+    def _record_round_completed(self):
+        """Call under self._lock, right after a round's waiters moved
+        into the completed world."""
+        self._metrics["rounds"].inc(rdzv=self.name)
+        self._metrics["waiting"].set(len(self._waiting), rdzv=self.name)
+        if self._round_start_time > 0:
+            self._metrics["quorum"].observe(
+                max(time.time() - self._round_start_time, 0.0),
+                rdzv=self.name,
+            )
 
     # ---- join / query ------------------------------------------------------
 
@@ -128,6 +169,7 @@ class RendezvousManager(ABC):
                 node_ip=node_ip,
                 node_group=node_group,
             )
+            self._metrics["waiting"].set(len(self._waiting), rdzv=self.name)
             logger.info(
                 "rdzv[%s] round %d: node rank %d joined (%d waiting)",
                 self.name,
@@ -271,6 +313,7 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
                 self._latest_world = self._order_world(world, chosen)
                 for w in chosen:
                     del self._waiting[w.node_rank]
+                self._record_round_completed()
                 if self._waiting:
                     # Unchosen nodes start the next pending round now.
                     self._round_start_time = time.time()
@@ -357,6 +400,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     world = {w.node_rank: w.local_world_size for w in chosen}
                     for w in chosen:
                         del self._waiting[w.node_rank]
+                    self._record_round_completed()
                     self._latest_world = dict(sorted(world.items()))
                     self._node_groups = self._group_nodes(
                         self._check_round, self._latest_world

@@ -30,6 +30,8 @@ class LocalJobMaster:
         node_num: int = 1,
         max_relaunch_count: int = 3,
         transport: str = "grpc",
+        batch_config=None,
+        devices_per_node: int = 1,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -38,12 +40,35 @@ class LocalJobMaster:
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager(perf_monitor=self.perf_monitor)
         self.diagnosis_master = self._build_diagnosis_master()
+        from dlrover_tpu.master.elastic_training.rescale_coordinator import (
+            RescaleCoordinator,
+            wire_batch_legality,
+        )
+
+        self.rescale_coordinator = RescaleCoordinator(
+            bootstrap_min=node_num
+        )
+        if batch_config is not None:
+            # Rendezvous and rescale plans only form worlds the trainer's
+            # batch config can actually train at (global_batch divisible
+            # by micro * dp) — a 3-of-4-survivors world must be truncated,
+            # not crash grad_accum_for().
+            # Legality must use the REAL dp = nodes * devices_per_node;
+            # defaulting to 1 here would admit worlds whose actual dp
+            # fails grad_accum_for() on arrival.
+            wire_batch_legality(
+                self.rdzv_managers,
+                self.rescale_coordinator,
+                batch_config,
+                local_world_size=devices_per_node,
+            )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
             job_manager=self.job_manager,
             diagnosis_master=self.diagnosis_master,
             perf_monitor=self.perf_monitor,
+            rescale_coordinator=self.rescale_coordinator,
         )
         self._server = create_master_server(port, self.servicer, transport)
         self.port = self._server.port

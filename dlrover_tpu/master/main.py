@@ -15,12 +15,24 @@ def run(args) -> int:
     if args.platform == "local":
         from dlrover_tpu.master.local_master import LocalJobMaster
 
+        batch_config = None
+        if args.global_batch_size > 0 and args.micro_batch_per_device > 0:
+            from dlrover_tpu.trainer.elastic.trainer import (
+                ElasticBatchConfig,
+            )
+
+            batch_config = ElasticBatchConfig(
+                global_batch_size=args.global_batch_size,
+                micro_batch_per_device=args.micro_batch_per_device,
+            )
         master = LocalJobMaster(
             port=args.port,
             job_name=args.job_name,
             node_num=args.node_num,
             max_relaunch_count=args.max_relaunch_count,
             transport=args.transport,
+            batch_config=batch_config,
+            devices_per_node=args.devices_per_node,
         )
     else:
         try:

@@ -15,6 +15,7 @@ from dlrover_tpu.common.constants import (
     PreCheckStatus,
     RendezvousName,
     TaskType,
+    TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.elastic_training.elastic_ps import ClusterVersionService
@@ -40,7 +41,9 @@ class MasterServicer(MasterService):
         kv_store: Optional[KVStoreService] = None,
         job_metric_collector=None,
         elastic_ps_service: Optional[ClusterVersionService] = None,
+        rescale_coordinator=None,
     ):
+        self._rescale_coordinator = rescale_coordinator
         self._rdzv_managers = rdzv_managers
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -90,6 +93,8 @@ class MasterServicer(MasterService):
             comm.ElasticRunConfigRequest: self._get_elastic_run_config,
             comm.JobDetailRequest: self._get_job_detail,
             comm.ClusterVersionRequest: self._get_cluster_version,
+            comm.RescalePlanRequest: self._get_rescale_plan,
+            comm.RescaleBarrierRequest: self._get_rescale_barrier,
         }
         self._report_handlers = {
             comm.JoinRendezvousRequest: self._join_rendezvous,
@@ -112,6 +117,8 @@ class MasterServicer(MasterService):
             comm.CkptStepReport: self._report_ckpt_step,
             comm.DiagnosisDataReport: self._report_diagnosis_data,
             comm.ClusterVersionReport: self._report_cluster_version,
+            comm.RescaleJoinReport: self._report_rescale_join,
+            comm.RescaleAckReport: self._report_rescale_ack,
         }
 
     # ---- transport entry points -------------------------------------------
@@ -207,6 +214,68 @@ class MasterServicer(MasterService):
         waiting = mgr.num_nodes_waiting() if mgr else 0
         return comm.NumNodesWaitingResponse(waiting_num=waiting)
 
+    # ---- live rescale ------------------------------------------------------
+
+    def _report_rescale_join(self, msg, req: comm.RescaleJoinReport):
+        if self._rescale_coordinator is None:
+            return comm.BaseResponse(False, "no rescale coordinator")
+        self._rescale_coordinator.note_worker_joined(
+            req.node_rank,
+            req.local_world_size,
+            node_group=getattr(req, "node_group", -1),
+        )
+        return comm.BaseResponse(True)
+
+    def _get_rescale_plan(self, msg, req: comm.RescalePlanRequest):
+        if self._rescale_coordinator is None:
+            return comm.RescalePlanResponse()
+        plan = self._rescale_coordinator.get_plan(
+            req.node_rank, req.current_plan_id
+        )
+        if plan is None:
+            return comm.RescalePlanResponse()
+        # Chaos site: the plan broadcast to THIS worker is dropped on
+        # the wire (raise -> transport error client-side). The pull
+        # protocol absorbs it: the worker's next poll re-fetches the
+        # same versioned plan.
+        fault_point(
+            "rescale.plan.broadcast",
+            plan_id=plan.plan_id,
+            rank=req.node_rank,
+        )
+        return comm.RescalePlanResponse(
+            plan_id=plan.plan_id,
+            world=dict(plan.world),
+            rank_order=list(plan.rank_order),
+            restore_step=plan.restore_step,
+            reason=plan.reason,
+            created_at=plan.created_at,
+            barrier_timeout_s=plan.barrier_timeout_s,
+        )
+
+    def _report_rescale_ack(self, msg, req: comm.RescaleAckReport):
+        if self._rescale_coordinator is None:
+            return comm.BaseResponse(False, "no rescale coordinator")
+        ok = self._rescale_coordinator.ack(
+            req.plan_id, req.node_rank, req.phase
+        )
+        return comm.BaseResponse(
+            ok, "" if ok else "stale plan or unknown rank/phase"
+        )
+
+    def _get_rescale_barrier(self, msg, req: comm.RescaleBarrierRequest):
+        if self._rescale_coordinator is None:
+            return comm.RescaleBarrierResponse()
+        ready, expired, superseded, missing = (
+            self._rescale_coordinator.barrier_state(req.plan_id, req.phase)
+        )
+        return comm.RescaleBarrierResponse(
+            ready=ready,
+            expired=expired,
+            superseded=superseded,
+            missing=missing,
+        )
+
     # ---- network check -----------------------------------------------------
 
     def _network_ready(self, msg, req):
@@ -257,6 +326,13 @@ class MasterServicer(MasterService):
         )
         if self._job_manager is not None:
             self._job_manager.handle_node_failure(req)
+        if (
+            self._rescale_coordinator is not None
+            and req.level == TrainingExceptionLevel.NODE_ERROR
+        ):
+            # A node-level failure means the rank is gone for good: fold
+            # it out of the live set so the next plan excludes it.
+            self._rescale_coordinator.note_worker_lost(req.node_rank)
         return comm.BaseResponse(True)
 
     def _report_succeeded(self, msg, req: comm.SucceededRequest):
@@ -385,6 +461,11 @@ class MasterServicer(MasterService):
     def _report_ckpt_step(self, msg, req: comm.CkptStepReport):
         if self._job_manager is not None:
             self._job_manager.update_ckpt_step(req.node_id, req.step, req.committed)
+        if self._rescale_coordinator is not None:
+            # The coordinator tracks the committed frontier itself so a
+            # rescale plan's restore_step works without a job manager
+            # (soak harness, standalone masters).
+            self._rescale_coordinator.note_ckpt_step(req.step, req.committed)
         return comm.BaseResponse(True)
 
     def _get_ckpt_latest_step(self, msg, req):

@@ -46,7 +46,16 @@ from dlrover_tpu.fault import FaultRule, FaultSchedule, arm, disarm
 from dlrover_tpu.fault.registry import SCHEDULE_ENV, TRACE_ENV
 from dlrover_tpu.testing import soak_worker as sw
 
-EPISODE_KINDS = ("crash_drop", "torn_ckpt", "serving_report")
+EPISODE_KINDS = (
+    "crash_drop",
+    "torn_ckpt",
+    "serving_report",
+    # Episode 3 of every seed: a live N→M rescale is SIGKILLed between
+    # the plan ack and the first post-rescale step (delegated to
+    # dlrover_tpu/testing/rescale_soak.py). Appended last so episodes
+    # 0-2 keep their historical (seed, episode) -> plan identity.
+    "kill_during_rescale",
+)
 
 
 class SoakInvariantError(AssertionError):
@@ -76,6 +85,10 @@ class EpisodePlan:
     fallback_step: int = 0         # expected restore step after torn
     worker_schedules: List[FaultSchedule] = field(default_factory=list)
     runner_schedule: Optional[FaultSchedule] = None
+    # kill_during_rescale only: per-RANK schedules for the multi-worker
+    # rescale episode (worker_schedules stays per-generation for the
+    # single-worker kinds).
+    rank_schedules: Dict[int, FaultSchedule] = field(default_factory=dict)
 
 
 def build_episode_plan(
@@ -156,6 +169,28 @@ def build_episode_plan(
                           nth=1, rule_id="shm-image-lost"),
             ], seed=ep_seed, label="gen1"),
         ]
+    elif kind == "kill_during_rescale":
+        # Rank 1 dies mid-step (cuts the scale-down plan); rank 0 is
+        # SIGKILLed in the restore-to-first-step window of THAT plan
+        # (resume hit 1 is the bootstrap plan, hit 2 the scale-down),
+        # and one plan broadcast is dropped on the wire for good
+        # measure — the pull protocol must redeliver it.
+        plan.crash_step = pick_crash_step()
+        plan.rank_schedules = {
+            1: FaultSchedule([
+                FaultRule("agent.worker.crash", action="crash",
+                          nth=plan.crash_step, rule_id="worker-sigkill"),
+            ], seed=ep_seed, label="rank1"),
+            0: FaultSchedule([
+                FaultRule("rescale.resume.first_step", action="crash",
+                          nth=2, rule_id="kill-mid-rescale"),
+            ], seed=ep_seed, label="rank0"),
+        }
+        runner_rules.append(FaultRule(
+            "rescale.plan.broadcast", action="raise",
+            nth=rng.randint(1, 3),
+            rule_id="drop-plan-broadcast",
+        ))
     else:  # serving_report
         plan.worker_schedules = [
             FaultSchedule([
@@ -438,6 +473,10 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
     ep_seed = seed * 10007 + episode
     rng = random.Random(ep_seed ^ 0x5EED)
     plan = build_episode_plan(seed, episode, cfg)
+    if plan.kind == "kill_during_rescale":
+        return _run_rescale_kind(
+            seed, episode, plan, cfg, work_dir, artifact_dir
+        )
     ep_dir = os.path.join(work_dir, f"soak-s{seed}-e{episode}")
     shutil.rmtree(ep_dir, ignore_errors=True)
     os.makedirs(os.path.join(ep_dir, "flight"), exist_ok=True)
@@ -606,6 +645,49 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
     })
     if not cfg.keep_artifacts_on_success:
         shutil.rmtree(ep_dir, ignore_errors=True)
+    return report
+
+
+def _run_rescale_kind(seed, episode, plan, cfg, work_dir, artifact_dir):
+    """Episode kind 4: delegate to the multi-worker live-rescale
+    harness and reshape its report to the soak report schema."""
+    from dlrover_tpu.testing.rescale_soak import (
+        RescaleSoakConfig,
+        run_rescale_episode,
+    )
+
+    rcfg = RescaleSoakConfig(
+        world=2,
+        dataset_size=cfg.dataset_size,
+        shard_size=cfg.shard_size,
+        ckpt_every=cfg.ckpt_every,
+        step_ms=cfg.step_ms,
+        watchdog_s=cfg.watchdog_s,
+        keep_artifacts_on_success=cfg.keep_artifacts_on_success,
+    )
+    try:
+        report = run_rescale_episode(
+            seed,
+            cfg=rcfg,
+            scenario="kill_during_rescale",
+            work_dir=work_dir,
+            artifact_dir=artifact_dir,
+            runner_schedule=plan.runner_schedule,
+            rank_schedules=plan.rank_schedules,
+        )
+    except SoakInvariantError:
+        print(
+            f"  repro: python tools/chaos_soak.py --seed {seed} "
+            f"--episode {episode}",
+            file=sys.stderr, flush=True,
+        )
+        raise
+    gens = report.pop("generations", {})
+    report.update({
+        "episode": episode,
+        "kind": plan.kind,
+        "generations": sum(g + 1 for g in gens.values()),
+    })
     return report
 
 

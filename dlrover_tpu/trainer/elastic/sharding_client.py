@@ -149,14 +149,21 @@ class ShardingClient:
             if self._prefetcher is None:
                 self._prefetcher = threading.Thread(
                     target=self._prefetch_loop,
+                    # Bound at spawn, NOT read from self inside the
+                    # loop: resume_after_rescale swaps the stop event
+                    # and queue attributes, and a stale thread that
+                    # outlived its pause join (wedged in a slow RPC)
+                    # must keep seeing ITS OWN set event and drain into
+                    # ITS OWN dead queue — never the new epoch's.
+                    args=(self._stopped, self._queue),
                     daemon=True,
                     name=f"shard-prefetch-{self.dataset_name}",
                 )
                 self._prefetcher.start()
 
-    def _prefetch_loop(self):
+    def _prefetch_loop(self, stopped: threading.Event, out_queue):
         backoff = self._wait_backoff_s
-        while not self._stopped.is_set():
+        while not stopped.is_set():
             # Reports first: keeps master-side shard accounting tight and
             # lets the master retire shards before handing out new ones.
             self._flush_if_due()
@@ -175,7 +182,7 @@ class ShardingClient:
                 logger.warning(
                     "shard prefetch RPC failed; retrying", exc_info=True
                 )
-                if self._stopped.wait(backoff):
+                if stopped.wait(backoff):
                     return
                 backoff = min(backoff * 2, self._wait_backoff_max_s)
                 continue
@@ -201,10 +208,10 @@ class ShardingClient:
                 if flushed:
                     # Our dones may have completed the dataset: re-poll
                     # soon, but not in a hot RPC loop.
-                    if self._stopped.wait(0.05):
+                    if stopped.wait(0.05):
                         return
                 else:
-                    if self._stopped.wait(
+                    if stopped.wait(
                         backoff * (1.0 + random.uniform(-0.3, 0.3))
                     ):
                         return
@@ -219,19 +226,19 @@ class ShardingClient:
                     self._recorder.annotate(
                         "data_exhausted", dataset=self.dataset_name
                     )
-                self._queue.put(_END)
+                out_queue.put(_END)
                 return
             self._metrics["tasks_fetched"].inc(len(tasks))
             self._metrics["rpcs_saved"].inc(len(tasks) - 1)
             for task in tasks:
                 while True:
                     try:
-                        self._queue.put(task, timeout=0.2)
+                        out_queue.put(task, timeout=0.2)
                         break
                     except queue.Full:
-                        if self._stopped.is_set():
+                        if stopped.is_set():
                             return
-                self._metrics["queue_depth"].set(self._queue.qsize())
+                self._metrics["queue_depth"].set(out_queue.qsize())
 
     def stop(self):
         """Stop the prefetcher and flush pending reports. Leases already
@@ -252,6 +259,64 @@ class ShardingClient:
             self._prefetcher.join(timeout=5.0)
         with self._report_lock:
             self._pending_done, self._pending_failed = [], []
+
+    # ---- live rescale ------------------------------------------------------
+
+    def pause_for_rescale(self) -> int:
+        """Tear down ONLY the data-path prefetcher for a live rescale
+        (docs/DESIGN.md §27): stop the prefetch thread, discard locally
+        queued-but-unconsumed leases (they stay in the master's
+        ``doing`` table and come back via the shard-snapshot restore or
+        timeout recovery — consuming them here after the state rolled
+        back would double-count their records), and force-flush pending
+        done-reports so the master's ledger reflects every shard this
+        worker actually finished BEFORE the rescale rolls the dataset
+        cursor back. Returns the number of reports flushed."""
+        self._stopped.set()
+        if self._prefetcher is not None:
+            self._prefetcher.join(timeout=5.0)
+            if self._prefetcher.is_alive():
+                # Wedged in a slow RPC. Safe to proceed: the thread
+                # holds ITS OWN (now set) stop event and ITS OWN queue,
+                # so it can neither feed the post-rescale queue nor
+                # outlive its next loop check — but say so, because a
+                # lease it fetches on the way out sits in the master's
+                # doing table until timeout recovery.
+                logger.warning(
+                    "prefetcher still draining a slow RPC at rescale "
+                    "pause; it will exit on its own stop event"
+                )
+        self._prefetcher = None
+        discarded = 0
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _END:
+                discarded += 1
+        self._current_task = None
+        flushed = self.flush_reports()
+        self._metrics["queue_depth"].set(0)
+        if self._recorder is not None:
+            self._recorder.annotate(
+                "rescale_pause",
+                dataset=self.dataset_name,
+                flushed=flushed,
+                discarded=discarded,
+            )
+        return flushed
+
+    def resume_after_rescale(self):
+        """Bring the data path back after the new world's shard cursor
+        is in place: fresh queue + stop flag, prefetcher restarts
+        lazily on the next fetch. The end-of-data sentinel is dropped
+        with the old queue — the snapshot restore may have re-queued
+        shards a previous world left in flight."""
+        self._stopped = threading.Event()
+        self._queue = queue.Queue(maxsize=self._prefetch_depth or 1)
+        self._prefetcher = None
+        self._current_task = None
 
     # ---- fetch -------------------------------------------------------------
 
@@ -289,6 +354,28 @@ class ShardingClient:
         self._metrics["queue_depth"].set(self._queue.qsize())
         self._current_task = item
         return item
+
+    def poll_task(self, timeout_s: float = 0.2):
+        """Non-blocking lease poll for lockstep consumers: ("task", t)
+        when a lease is ready, ("end", None) once the dataset is
+        exhausted, ("wait", None) when nothing arrived within
+        ``timeout_s`` (peers hold the remaining shards, or the
+        prefetcher is still warming) — the caller keeps its collective
+        step loop turning instead of blocking a whole world on one
+        rank's empty queue."""
+        if not self.prefetching:
+            raise RuntimeError("poll_task requires prefetch_depth > 0")
+        self._ensure_prefetcher()
+        try:
+            item = self._queue.get(timeout=max(timeout_s, 0.0))
+        except queue.Empty:
+            return ("end", None) if self._stopped.is_set() else ("wait", None)
+        if item is _END:
+            self._queue.put(_END)
+            return "end", None
+        self._metrics["queue_depth"].set(self._queue.qsize())
+        self._current_task = item
+        return "task", item
 
     def _fetch_task_sync(self) -> Optional[comm.ShardTask]:
         backoff = self._wait_backoff_s

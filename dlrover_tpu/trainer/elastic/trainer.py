@@ -11,6 +11,7 @@ schedule surgery.
 
 import time
 from dataclasses import dataclass
+from typing import Callable, List
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
@@ -30,6 +31,34 @@ class ElasticBatchConfig:
                 f"micro({self.micro_batch_per_device}) x dp({dp_size})"
             )
         return self.global_batch_size // denom
+
+    def is_legal_dp(self, dp_size: int) -> bool:
+        denom = self.micro_batch_per_device * dp_size
+        return denom > 0 and self.global_batch_size % denom == 0
+
+    def legal_dp_sizes(self, max_dp: int) -> List[int]:
+        """Data-parallel sizes this batch config can train at."""
+        return [dp for dp in range(1, max_dp + 1) if self.is_legal_dp(dp)]
+
+    def legal_node_counts_fn(
+        self, local_world_size: int = 1
+    ) -> Callable[[int, int], List[int]]:
+        """A ``legal_counts_fn`` for ``RendezvousManager`` and
+        ``RescaleCoordinator``: node counts that are both topology-legal
+        (multiples of ``node_unit``) AND batch-legal (``global_batch %
+        (micro * nodes * local_world_size) == 0``). Without this wiring
+        a 3-of-4-survivors rendezvous would form a world whose
+        ``grad_accum_for`` raises — crashing the job it just saved."""
+
+        def legal_counts(max_nodes: int, node_unit: int) -> List[int]:
+            unit = max(node_unit, 1)
+            return [
+                n
+                for n in range(unit, max_nodes + 1, unit)
+                if self.is_legal_dp(n * max(local_world_size, 1))
+            ]
+
+        return legal_counts
 
 
 class ElasticTrainer:

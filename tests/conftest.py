@@ -38,3 +38,8 @@ def pytest_configure(config):
         "soak: seeded chaos-soak episodes through the whole stack; "
         "pair with slow for the CI slow lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "rescale: live elastic N→M rescale protocol (plan broadcast, "
+        "barrier, resharded restore) — docs/DESIGN.md §27",
+    )

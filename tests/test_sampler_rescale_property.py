@@ -1,0 +1,126 @@
+"""Property test for ElasticDistributedSampler under live rescale
+(ISSUE 6 satellite): across random mid-epoch rescale points, the union
+of indices yielded by all ranks covers each remaining record exactly
+once — no revisit, no loss — including the drop_last tail, which must
+consist of exactly the final ``remaining % world`` records of the
+epoch's permuted order."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer.elastic.sampler import ElasticDistributedSampler
+
+
+def _epoch_permutation(size, shuffle, seed, epoch=0):
+    if shuffle:
+        return np.random.default_rng(seed + epoch).permutation(size)
+    return np.arange(size)
+
+
+def _run_trial(rng: random.Random):
+    size = rng.randint(40, 200)
+    seed = rng.randint(0, 10_000)
+    shuffle = rng.random() < 0.5
+    drop_last = rng.random() < 0.5
+    state = {"epoch": 0, "completed": 0, "dataset_size": size}
+    consumed = []
+    worlds = []
+    while True:
+        world = rng.randint(1, 5)
+        per_rank = rng.randint(1, 3)
+        gb = world * per_rank
+        worlds.append(world)
+        remaining = size - state["completed"]
+        usable = remaining - (remaining % world if drop_last else 0)
+        max_full_batches = usable // gb
+        samplers = []
+        for r in range(world):
+            s = ElasticDistributedSampler(
+                size, 0, 1, shuffle=shuffle, seed=seed,
+                drop_last=drop_last,
+            )
+            s.load_state_dict(state)
+            # the live-rescale call under test: adopt the new world,
+            # keep the global cursor
+            s.rescale(r, world)
+            samplers.append(s)
+        iters = [iter(s) for s in samplers]
+        if max_full_batches <= 1 or rng.random() < 0.3:
+            # Final segment: run the epoch out on this world.
+            for it in iters:
+                consumed.extend(it)
+            return {
+                "size": size,
+                "seed": seed,
+                "shuffle": shuffle,
+                "drop_last": drop_last,
+                "consumed": consumed,
+                "final_world": world,
+                "completed_at_final": state["completed"],
+                "worlds": worlds,
+            }
+        # Mid-epoch segment: some full global batches, then rescale.
+        n_batches = rng.randint(1, max_full_batches - 1)
+        for it in iters:
+            for _ in range(n_batches * per_rank):
+                consumed.append(next(it))
+        # all ranks advance the shared global cursor, as record_batch
+        # does once per consumed global batch
+        for s in samplers:
+            for _ in range(n_batches):
+                s.record_batch(gb)
+        assert samplers[0]._completed == state["completed"] + n_batches * gb
+        state = samplers[0].state_dict()
+
+
+@pytest.mark.rescale
+def test_rescale_points_cover_every_record_exactly_once():
+    rng = random.Random(0xE1A57)
+    for trial in range(60):
+        r = _run_trial(rng)
+        consumed = r["consumed"]
+        assert len(consumed) == len(set(consumed)), (
+            f"trial {trial}: records revisited (worlds {r['worlds']})"
+        )
+        perm = _epoch_permutation(r["size"], r["shuffle"], r["seed"])
+        if not r["drop_last"]:
+            assert set(consumed) == set(range(r["size"])), (
+                f"trial {trial}: records lost (worlds {r['worlds']})"
+            )
+            continue
+        # drop_last: the ONLY permissible loss is the final segment's
+        # tail — exactly the last (remaining % final_world) records of
+        # the permuted remaining sequence.
+        remaining_seq = [
+            int(i) for i in perm[r["completed_at_final"]:]
+        ]
+        tail_len = len(remaining_seq) % r["final_world"]
+        dropped = set(remaining_seq[len(remaining_seq) - tail_len:]) \
+            if tail_len else set()
+        assert set(consumed) == set(range(r["size"])) - dropped, (
+            f"trial {trial}: drop_last tail mishandled "
+            f"(worlds {r['worlds']}, tail {sorted(dropped)})"
+        )
+
+
+@pytest.mark.rescale
+def test_rescale_keeps_cursor_monotonic_and_len_consistent():
+    """__len__ of each rank after a rescale matches what its iterator
+    actually yields."""
+    rng = random.Random(7)
+    for _ in range(20):
+        size = rng.randint(10, 100)
+        completed = rng.randint(0, size)
+        world = rng.randint(1, 4)
+        drop_last = rng.random() < 0.5
+        for r in range(world):
+            s = ElasticDistributedSampler(
+                size, 0, 1, shuffle=True, seed=3, drop_last=drop_last
+            )
+            s.load_state_dict({
+                "epoch": 0, "completed": completed, "dataset_size": size
+            })
+            s.rescale(r, world)
+            assert len(list(iter(s))) == len(s)

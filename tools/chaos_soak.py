@@ -1,18 +1,23 @@
 """Seeded chaos-soak CLI: drive the whole stack through reproducible
-fault episodes and assert the four system invariants.
+fault episodes and assert the five system invariants.
 
-    python tools/chaos_soak.py --seed 0 --episodes 3
+    python tools/chaos_soak.py --seed 0 --episodes 4
     python tools/chaos_soak.py --seed 0 --episode 1      # repro one
+    python tools/chaos_soak.py --seed 0 --episode 3      # rescale kill
 
-Each episode runs an in-process master, a crash-restartable worker
-subprocess and a serving engine under a deterministic seeded fault
-schedule (worker SIGKILL mid-step, dropped RPC replies, torn checkpoint
-shard writes, serving step errors, ...). The implementation and the
-invariant definitions live in ``dlrover_tpu/testing/soak.py``
-(docs/DESIGN.md §26); exit code 0 means every episode held every
-invariant. Prints one JSON summary line with goodput fraction and
-per-fault MTTR — the same numbers ``bench.py``'s ``chaos_goodput``
-phase reports.
+Each episode runs an in-process master, worker subprocesses and a
+serving engine under a deterministic seeded fault schedule (worker
+SIGKILL mid-step, dropped RPC replies, torn checkpoint shard writes,
+serving step errors, SIGKILL mid-live-rescale ...). Episode 3 is the
+multi-worker ``kill_during_rescale`` episode
+(``dlrover_tpu/testing/rescale_soak.py``): a worker is killed between
+the rescale-plan ack and the first post-rescale step, and the restored
+state must still be bit-identical to the single-host reference. The
+implementation and the invariant definitions live in
+``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26/§27); exit code 0
+means every episode held every invariant. Prints one JSON summary line
+with goodput fraction and per-fault MTTR — the same numbers
+``bench.py``'s ``chaos_goodput`` phase reports.
 """
 
 import argparse
@@ -32,7 +37,11 @@ from dlrover_tpu.testing.soak import (  # noqa: E402
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="seeded chaos soak")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--episodes", type=int, default=3)
+    parser.add_argument(
+        "--episodes", type=int, default=4,
+        help="episode count; 4 covers the full fault matrix incl. "
+        "kill_during_rescale",
+    )
     parser.add_argument(
         "--episode", type=int, default=None,
         help="run only this episode index (repro mode)",
