@@ -1410,6 +1410,23 @@ def serving_phase():
     return {f"serving_{k}": v for k, v in r.items()}
 
 
+def fleet_phase():
+    """Self-healing serving fleet through the real router
+    (tools/bench_fleet.py): a FleetRouter over N subprocess replicas vs
+    the single-engine baseline on the same Poisson schedule, plus a
+    degraded run with one replica SIGKILLed mid-stream (reclaim +
+    re-route + breaker-gated restart). Host + CPU-jax subprocesses —
+    runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_fleet
+
+    r = bench_fleet.run_bench()
+    return {f"fleet_{k}": v for k, v in r.items()}
+
+
 def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
@@ -1525,6 +1542,9 @@ _KEEP_KEYS = {
     "ce_auto_path",
     "soak_goodput_frac", "soak_mttr_mean_s", "soak_invariants",
     "rescale_to_first_step_s", "rescale_invariants",
+    "fleet_tokens_per_s", "fleet_speedup_vs_single",
+    "fleet_ttft_p99_s", "fleet_kill_ttft_p99_s",
+    "fleet_kill_completed_frac",
     "prev_round_diff",
 }
 
@@ -1545,6 +1565,8 @@ _DROP_ORDER = (
     r"^soak_(faults|episodes|deaths|mttr_max)",
     r"^rescale_(plans|deaths|events|goodput|barrier|restore"
     r"|to_first_step_mean)",
+    r"^fleet_(replicas|requests|single_|ttft_p50|kill_(tokens|reroutes"
+    r"|retries|restarts))",
     r"^(ckpt_|raw_run_goodput|replay_s$|step_time_s|tokens_per_s)",
     r"^e2e_(detect|runtime|replay|replayed|autotuned|effective"
     r"|goodput_at|restore_s$|succeeded)",
@@ -1712,6 +1734,10 @@ def main():
         # model, every platform (the discipline, not the kernels, is
         # what's measured — decode_phase owns the flagship kernels).
         run_phase(result, "serving", serving_phase, est_s=60, cap_s=240)
+        # Self-healing serving fleet: router over N subprocess replicas
+        # vs single-engine baseline, plus a kill-mid-run degraded run.
+        # Host + CPU subprocesses, every platform.
+        run_phase(result, "fleet", fleet_phase, est_s=60, cap_s=240)
         # Chaos soak: seeded fault episodes through the whole stack with
         # invariant checks; reports chaos goodput + per-fault MTTR.
         run_phase(

@@ -113,6 +113,22 @@ KNOWN_POINTS: Dict[str, str] = {
         "serving engine: an iteration is about to run its compiled "
         "programs — raise simulates a device/XLA error mid-decode"
     ),
+    "fleet.router.dispatch": (
+        "fleet router: a request is about to be handed to a chosen "
+        "replica (ctx: replica, request) — raise drives the bounded "
+        "retry / re-dispatch-to-a-different-replica path"
+    ),
+    "fleet.replica.step": (
+        "fleet replica serve loop: one iteration is about to run "
+        "(ctx: replica) — raise kills a thread replica's loop (the "
+        "router must detect the silent death via heartbeats), crash "
+        "SIGKILLs a subprocess replica mid-decode"
+    ),
+    "fleet.health.heartbeat": (
+        "fleet replica: a heartbeat is about to be recorded/emitted "
+        "(ctx: replica) — raise drops it (missed-heartbeat strikes), "
+        "delay simulates a stalled replica"
+    ),
     "sync.wait": (
         "sync service: a bounded barrier wait is starting — delay "
         "pushes it into its timeout path"

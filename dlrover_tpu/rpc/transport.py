@@ -13,6 +13,7 @@ servicer.py:994 HttpMasterServicer).
 
 import abc
 import http.client
+import os
 import threading
 import time
 from concurrent import futures
@@ -35,6 +36,28 @@ GRPC_MAX_MESSAGE = 512 * 1024 * 1024  # checkpoints metadata can be chunky
 # and every expiry ticks a counter so "could not reach the master in
 # time" shows up on /metrics instead of only in scattered caller logs.
 WAIT_READY_TIMEOUT_S = 60.0
+
+# Env-tunable socket phases for the HTTP stub: connect (TCP handshake
+# to the master) and read (waiting on a reply over an established
+# connection) fail differently — a hung master accepts connections and
+# then never answers, so a single coarse timeout either stalls workers
+# or flakes connects. Either unset falls back to the stub's ctor
+# timeout; a hung master then surfaces as a bounded socket.timeout (a
+# retryable transport error) instead of a stuck thread.
+CONNECT_TIMEOUT_ENV = "DLROVER_TPU_RPC_CONNECT_TIMEOUT_S"
+READ_TIMEOUT_ENV = "DLROVER_TPU_RPC_READ_TIMEOUT_S"
+
+
+def _env_timeout(name: str) -> Optional[float]:
+    raw = os.getenv(name, "")
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return None
+    return val if val > 0 else None
 
 
 def _wait_ready_expired_counter():
@@ -216,6 +239,11 @@ class HttpMasterStub:
         self._host, port = addr.rsplit(":", 1)
         self._port = int(port)
         self._timeout = timeout
+        # Env overrides (read once at construction so a long-lived stub
+        # is consistent): connect bounds the TCP handshake, read bounds
+        # each wait for reply bytes on the established socket.
+        self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_ENV)
+        self._read_timeout = _env_timeout(READ_TIMEOUT_ENV)
         self._local = threading.local()
         self._closed = False
 
@@ -224,10 +252,31 @@ class HttpMasterStub:
         be a stale keep-alive socket rather than a dead master."""
         conn = getattr(self._local, "conn", None)
         if conn is not None:
-            return conn, True
+            if (
+                self._read_timeout is not None
+                and getattr(conn, "sock", None) is None
+            ):
+                # The peer closed the keep-alive socket. With split
+                # timeouts, http.client's silent auto-reconnect would
+                # stamp the (short) connect timeout on the new socket
+                # and apply it to reads — rebuild through the eager-
+                # connect path below instead.
+                self._drop_connection()
+            else:
+                return conn, True
+        base = timeout or self._timeout
         conn = http.client.HTTPConnection(
-            self._host, self._port, timeout=timeout or self._timeout
+            self._host, self._port,
+            timeout=self._connect_timeout or base,
         )
+        # http.client stamps the connection timeout onto the socket at
+        # connect(); connecting eagerly here lets the read phase get its
+        # own (usually longer) bound — a master that accepts but never
+        # answers surfaces as socket.timeout instead of a stuck thread.
+        read_timeout = self._read_timeout or base
+        if read_timeout != (self._connect_timeout or base):
+            conn.connect()
+            conn.sock.settimeout(read_timeout)
         self._local.conn = conn
         return conn, False
 

@@ -260,8 +260,11 @@ class ServingEngine:
         return dict(self._steps.trace_counts)
 
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0) -> Request:
-        req = self.scheduler.submit(prompt, max_new_tokens, temperature)
+               temperature: float = 0.0,
+               deadline_s: Optional[float] = None) -> Request:
+        req = self.scheduler.submit(
+            prompt, max_new_tokens, temperature, deadline_s=deadline_s
+        )
         self.metrics.queue_depth.set(len(self.scheduler.queue))
         return req
 
@@ -312,6 +315,16 @@ class ServingEngine:
         t0 = time.monotonic()
         sch = self.scheduler
         finished: List[Request] = []
+        for req in sch.shed_expired(t0):
+            # Past-deadline queued work is an explicit terminal outcome,
+            # surfaced through step()'s return like any completion.
+            finished.append(req)
+            self.metrics.shed.inc(reason="deadline")
+            self.metrics.requests.inc(outcome="shed")
+            self.metrics.failures.inc(reason="deadline")
+            self.metrics.annotate(
+                "serving_shed", rid=req.rid, reason="deadline"
+            )
         for req in sch.admit():
             # A recycled slot starts from fill 0: stale KV above the
             # cursor is invisible and rewritten before visibility.
@@ -387,10 +400,12 @@ class ServingEngine:
                 except ValueError:
                     pass
                 req.failed = True
+                req.failure_reason = "requeue_budget"
                 self.scheduler.finish(req)
                 finished.append(req)
                 failed += 1
                 self.metrics.requests.inc(outcome="failed")
+                self.metrics.failures.inc(reason="requeue_budget")
             else:
                 self.metrics.requests.inc(outcome="requeued")
         self.metrics.annotate(

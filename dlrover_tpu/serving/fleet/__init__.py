@@ -1,0 +1,43 @@
+"""Self-healing serving fleet: a health-gated router over N engine
+replicas — deadlines, bounded retries, hedging, load shedding, crash
+re-routing. See router.py for the contract and docs/DESIGN.md §28."""
+
+from dlrover_tpu.serving.fleet.health import (
+    BROKEN,
+    HALF_OPEN,
+    HEALTHY,
+    SUSPECT,
+    HealthPolicy,
+    ReplicaHealth,
+)
+from dlrover_tpu.serving.fleet.metrics import fleet_metrics
+from dlrover_tpu.serving.fleet.replica import (
+    ReplicaDeadError,
+    SubprocessReplica,
+    ThreadReplica,
+    WorkItem,
+)
+from dlrover_tpu.serving.fleet.router import (
+    FleetRequest,
+    FleetResult,
+    FleetRouter,
+    RouterConfig,
+)
+
+__all__ = [
+    "FleetRouter",
+    "RouterConfig",
+    "FleetRequest",
+    "FleetResult",
+    "ThreadReplica",
+    "SubprocessReplica",
+    "WorkItem",
+    "ReplicaDeadError",
+    "ReplicaHealth",
+    "HealthPolicy",
+    "HEALTHY",
+    "SUSPECT",
+    "BROKEN",
+    "HALF_OPEN",
+    "fleet_metrics",
+]

@@ -1,0 +1,491 @@
+"""Replica handles: a ServingEngine behind a mailbox with heartbeats.
+
+The router never touches an engine directly — it talks to a
+:class:`ReplicaHandle`: ``submit()`` drops work into the replica's
+mailbox, ``poll()`` drains completion events, ``last_heartbeat()`` /
+``alive()`` feed the health breaker. Two implementations share that
+surface:
+
+- :class:`ThreadReplica` — the engine runs on an in-process thread.
+  Compiled step programs are shared across replicas with the same shape
+  key (the engine's ``lru_cache``), so N replicas cost one compile.
+  ``kill()`` poisons the loop (it exits without draining — in-flight
+  work is lost and heartbeats stop), which is the thread-mode analogue
+  of a crash; an injected ``fleet.replica.step`` raise does the same.
+- :class:`SubprocessReplica` — the engine runs in a child process
+  (:mod:`dlrover_tpu.serving.fleet.replica_worker`), the ``soak_worker``
+  pattern: JSONL commands down stdin, JSONL events (completions +
+  heartbeats) up stdout, fault schedules armed via the standard env
+  rigging. ``kill()`` is a real SIGKILL — the chaos episode's replica
+  death. ``restart()`` respawns a fresh generation.
+
+Completion events are plain dicts (the wire format IS the in-process
+format, so the router cannot care which mode a replica runs in)::
+
+    {"kind": "done", "request_id": ..., "attempt": ..., "ok": bool,
+     "tokens": [...], "truncated": bool, "failure_reason": "",
+     "ttft_s": float|None}
+
+Every event carries the replica's ``generation`` — a completion from a
+pre-restart generation for an attempt the router already re-routed is
+recognizably stale (the at-most-once key still wins; generations make
+the logs honest).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
+from dlrover_tpu.fault.registry import SCHEDULE_ENV, TRACE_ENV
+
+
+class ReplicaDeadError(RuntimeError):
+    """submit() on a replica that cannot take work (process exited,
+    thread gone, pipe closed). The router turns this into a re-route."""
+
+
+@dataclass
+class WorkItem:
+    """One dispatch: a (request, attempt) pair bound for one replica.
+    ``deadline_s`` is REMAINING seconds at dispatch (never an absolute
+    timestamp — subprocess replicas have their own monotonic clock)."""
+
+    request_id: str
+    attempt: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    deadline_s: Optional[float] = None
+
+    def to_wire(self) -> dict:
+        return {
+            "op": "submit",
+            "request_id": self.request_id,
+            "attempt": self.attempt,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "deadline_s": self.deadline_s,
+        }
+
+
+def _completion(item_key, ok, tokens, truncated, failure_reason,
+                ttft_s, generation=None) -> dict:
+    request_id, attempt = item_key
+    out = {
+        "kind": "done",
+        "request_id": request_id,
+        "attempt": attempt,
+        "ok": bool(ok),
+        "tokens": list(tokens),
+        "truncated": bool(truncated),
+        "failure_reason": failure_reason,
+        "ttft_s": ttft_s,
+    }
+    # generation=None (subprocess worker): omitted so the parent can
+    # stamp its own at receipt (_read_events setdefault) — a worker
+    # cannot know which respawn it is.
+    if generation is not None:
+        out["generation"] = generation
+    return out
+
+
+def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
+                 max_new_tokens, temperature, deadline_s) -> None:
+    """One work item into the engine — shared by both replica modes so
+    the wire behavior cannot drift. A scheduler rejection (prompt too
+    long, bad deadline) is an EXPLICIT failed completion, never a crash:
+    crashing here would cascade the poison request through the fleet."""
+    try:
+        req = engine.submit(
+            prompt, max_new_tokens,
+            temperature=temperature, deadline_s=deadline_s,
+        )
+    except Exception:  # noqa: BLE001 — any rejection is the same event
+        emit(_completion(
+            (request_id, attempt),
+            ok=False, tokens=(), truncated=False,
+            failure_reason="rejected", ttft_s=None,
+        ))
+    else:
+        by_rid[req.rid] = (request_id, attempt)
+
+
+def serve_step(engine, by_rid, emit) -> None:
+    """One engine iteration -> one completion event per finished
+    request — shared by both replica modes."""
+    for req in engine.step():
+        key = by_rid.pop(req.rid, None)
+        if key is None:
+            continue  # engine-internal request (warmup etc.)
+        emit(_completion(
+            key,
+            ok=not req.failed,
+            tokens=req.tokens,
+            truncated=req.truncated,
+            failure_reason=req.failure_reason,
+            ttft_s=req.ttft_s,
+        ))
+
+
+class ThreadReplica:
+    """In-process replica: one serve-loop thread driving one engine.
+
+    ``engine_factory`` is called ON the loop thread (first start pays
+    any compile there, not on the router); each ``restart()`` builds a
+    fresh engine — after a poisoned loop the old engine's host/device
+    state is untrusted, exactly like the engine's own step-error
+    recovery, and the compiled programs are cached anyway.
+    """
+
+    mode = "thread"
+
+    def __init__(
+        self,
+        replica_id: str,
+        engine_factory: Callable[[], object],
+        clock: Callable[[], float] = time.monotonic,
+        idle_sleep_s: float = 0.001,
+    ):
+        self.replica_id = str(replica_id)
+        self._engine_factory = engine_factory
+        self._clock = clock
+        self._idle_sleep_s = idle_sleep_s
+        self._inbox: Deque[WorkItem] = deque()
+        self._outbox: Deque[dict] = deque()
+        self._lock = threading.Lock()
+        self._hb = 0.0
+        self._stop = threading.Event()
+        self._poison = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self.generation = 0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._poison.clear()
+        self._ready.clear()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"fleet-replica-{self.replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        return self._ready.wait(timeout)
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def kill(self) -> None:
+        """Simulated crash: the loop exits at its next iteration WITHOUT
+        draining — in-flight work is lost, heartbeats stop."""
+        self._poison.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def restart(self) -> None:
+        self.stop()
+        if self._thread is not None and self._thread.is_alive():
+            # Wedged loop that would not join: abandon it. The
+            # generation guard in _run makes it exit at its next
+            # iteration boundary, and any events it still emits carry
+            # its old generation.
+            self._thread = None
+        with self._lock:
+            self._inbox.clear()
+        self.generation += 1
+        self.start()
+
+    # ---- router surface ----------------------------------------------------
+
+    def submit(self, item: WorkItem) -> None:
+        if not self.alive():
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} is not running"
+            )
+        with self._lock:
+            self._inbox.append(item)
+
+    def poll(self) -> List[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self._outbox.popleft())
+            except IndexError:
+                return out
+
+    def last_heartbeat(self) -> float:
+        return self._hb
+
+    # ---- serve loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        generation = self.generation
+        try:
+            engine = self._engine_factory()
+        except Exception:
+            logger.exception(
+                "replica %s engine build failed", self.replica_id
+            )
+            return
+        self._ready.set()
+        self._hb = self._clock()
+        by_rid: Dict[int, tuple] = {}   # engine rid -> (request_id, attempt)
+
+        def emit(event: dict) -> None:
+            event["generation"] = generation
+            self._outbox.append(event)
+        while not self._stop.is_set():
+            if self.generation != generation:
+                return  # abandoned by a restart while wedged
+            if self._poison.is_set():
+                return  # crash: no drain, no farewell, heartbeats stop
+            try:
+                fault_point("fleet.replica.step", replica=self.replica_id)
+            except Exception:
+                # Injected step fault = the loop dies silently, the way
+                # a wedged device thread would. Detection is the
+                # router's job (heartbeats + alive()).
+                return
+            try:
+                fault_point(
+                    "fleet.health.heartbeat", replica=self.replica_id
+                )
+                self._hb = self._clock()
+            except Exception:
+                pass  # dropped heartbeat: the breaker strikes accrue
+            moved = False
+            while True:
+                with self._lock:
+                    item = (
+                        self._inbox.popleft() if self._inbox else None
+                    )
+                if item is None:
+                    break
+                serve_submit(
+                    engine, by_rid, emit,
+                    item.request_id, item.attempt, item.prompt,
+                    item.max_new_tokens, item.temperature,
+                    item.deadline_s,
+                )
+                moved = True
+            if engine.pending():
+                serve_step(engine, by_rid, emit)
+                moved = True
+            if not moved:
+                time.sleep(self._idle_sleep_s)
+
+
+class SubprocessReplica:
+    """Out-of-process replica over stdin/stdout JSONL (the
+    ``soak_worker`` rigging pattern: env-armed fault schedules, fsynced
+    fault traces, per-generation log files)."""
+
+    mode = "subprocess"
+
+    def __init__(
+        self,
+        replica_id: str,
+        work_dir: str,
+        slots: int = 2,
+        max_len: int = 64,
+        prefill_chunk: int = 8,
+        heartbeat_s: float = 0.2,
+        step_delay_ms: float = 0.0,
+        schedule_path="",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        # ``schedule_path``: a str arms the same fault schedule on every
+        # generation; a sequence indexes by generation ("" past the end)
+        # — the soak-worker pattern, so a replica SIGKILLed by its gen-0
+        # schedule comes back CLEAN and can actually recover instead of
+        # deterministically re-dying at the same hit count forever.
+        self.replica_id = str(replica_id)
+        self._work_dir = work_dir
+        self._slots = slots
+        self._max_len = max_len
+        self._prefill_chunk = prefill_chunk
+        self._heartbeat_s = heartbeat_s
+        self._step_delay_ms = step_delay_ms
+        self._schedule_path = schedule_path
+        self._clock = clock
+        self._proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._outbox: Deque[dict] = deque()
+        self._hb = 0.0
+        self._ready = threading.Event()
+        self._stdin_lock = threading.Lock()
+        self.generation = 0
+        os.makedirs(work_dir, exist_ok=True)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            return
+        import dlrover_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(dlrover_tpu.__file__)
+        ))
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo_root + os.pathsep + env.get(
+                "PYTHONPATH", ""
+            ),
+            TRACE_ENV: os.path.join(
+                self._work_dir,
+                f"trace_replica{self.replica_id}.jsonl",
+            ),
+        })
+        sched = self._schedule_path
+        if not isinstance(sched, str):
+            sched = (
+                sched[self.generation]
+                if self.generation < len(sched) else ""
+            )
+        if sched:
+            env[SCHEDULE_ENV] = sched
+        else:
+            env.pop(SCHEDULE_ENV, None)
+        args = [
+            sys.executable, "-m",
+            "dlrover_tpu.serving.fleet.replica_worker",
+            "--replica-id", self.replica_id,
+            "--slots", str(self._slots),
+            "--max-len", str(self._max_len),
+            "--prefill-chunk", str(self._prefill_chunk),
+            "--heartbeat-s", str(self._heartbeat_s),
+            "--step-delay-ms", str(self._step_delay_ms),
+        ]
+        log_path = os.path.join(
+            self._work_dir,
+            f"replica{self.replica_id}_gen{self.generation}.log",
+        )
+        self._ready.clear()
+        with open(log_path, "w") as log:
+            # The child duplicates the fd; closing the parent handle
+            # keeps long fleets from accumulating fds.
+            self._proc = subprocess.Popen(
+                args, env=env, cwd=repo_root,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=log, text=True, bufsize=1,
+            )
+        self._reader = threading.Thread(
+            target=self._read_events,
+            args=(self._proc, self.generation),
+            name=f"fleet-replica-{self.replica_id}-reader",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        return self._ready.wait(timeout)
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+
+    def stop(self) -> None:
+        if self._proc is None:
+            return
+        if self._proc.poll() is None:
+            try:
+                self._send({"op": "stop"})
+                self._proc.wait(timeout=5)
+            except (ReplicaDeadError, subprocess.TimeoutExpired):
+                self._proc.kill()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        if self._reader is not None:
+            self._reader.join(timeout=2)
+
+    def restart(self) -> None:
+        self.stop()
+        self.generation += 1
+        self.start()
+
+    # ---- router surface ----------------------------------------------------
+
+    def submit(self, item: WorkItem) -> None:
+        self._send(item.to_wire())
+
+    def poll(self) -> List[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self._outbox.popleft())
+            except IndexError:
+                return out
+
+    def last_heartbeat(self) -> float:
+        return self._hb
+
+    # ---- internals ---------------------------------------------------------
+
+    def _send(self, payload: dict) -> None:
+        if not self.alive():
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} process is not running"
+            )
+        line = json.dumps(payload) + "\n"
+        try:
+            with self._stdin_lock:
+                self._proc.stdin.write(line)
+                self._proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError) as e:
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} pipe closed: {e}"
+            ) from e
+
+    def _read_events(self, proc: subprocess.Popen, generation: int):
+        """Drain the child's stdout until EOF (exit/SIGKILL). Heartbeats
+        update the timestamp in place; completions queue for poll().
+        The heartbeat is stamped with the PARENT clock at receipt — the
+        breaker compares against the router's clock, and a dead child's
+        last self-reported time would lie about when it was last seen."""
+        try:
+            for line in proc.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue  # torn line from a SIGKILL mid-write
+                kind = event.get("kind")
+                if kind == "heartbeat":
+                    self._hb = self._clock()
+                elif kind == "ready":
+                    self._hb = self._clock()
+                    self._ready.set()
+                elif kind == "done":
+                    event.setdefault("generation", generation)
+                    self._hb = self._clock()
+                    self._outbox.append(event)
+        except (OSError, ValueError):
+            pass

@@ -1,0 +1,134 @@
+"""Fleet replica subprocess: one ServingEngine behind stdin/stdout JSONL.
+
+Spawned (and respawned after every crash) by
+:class:`dlrover_tpu.serving.fleet.replica.SubprocessReplica` — the
+``soak_worker`` pattern applied to serving: fault schedules arm from
+``DLROVER_TPU_FAULT_SCHEDULE``, fired injections append (fsynced) to
+``DLROVER_TPU_FAULT_TRACE`` BEFORE acting, so even this process's own
+SIGKILL leaves its trace entry behind.
+
+Protocol (one JSON object per line):
+
+- stdin:  ``{"op": "submit", "request_id", "attempt", "prompt",
+  "max_new_tokens", "temperature", "deadline_s"}`` | ``{"op": "stop"}``
+- stdout: ``{"kind": "ready"}`` once warm, ``{"kind": "heartbeat"}``
+  every ``--heartbeat-s`` while serving, and one ``{"kind": "done",
+  ...}`` completion per accepted (request, attempt) — ok, explicitly
+  failed, or shed; never silence.
+
+The model is the deterministic tiny llama (seed 0), so every replica in
+a fleet serves identical weights and a re-routed greedy request decodes
+the same tokens on its new replica.
+"""
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _read_commands(q: "queue.Queue[dict]") -> None:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            q.put(json.loads(line))
+        except ValueError:
+            continue
+    q.put({"op": "stop"})  # parent closed the pipe
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="fleet replica worker")
+    parser.add_argument("--replica-id", default="0")
+    parser.add_argument("--slots", type=int, default=2)
+    parser.add_argument("--max-len", type=int, default=64)
+    parser.add_argument("--prefill-chunk", type=int, default=8)
+    parser.add_argument("--heartbeat-s", type=float, default=0.2)
+    parser.add_argument(
+        "--step-delay-ms", type=float, default=0.0,
+        help="simulated accelerator milliseconds per engine iteration "
+        "(the soak-worker --step-ms idiom): sleeping releases the "
+        "host CPU, so a fleet bench on a small host measures the "
+        "router/host plane, not the tiny model's CPU decode",
+    )
+    args = parser.parse_args(argv)
+
+    from dlrover_tpu.fault import arm_from_env, fault_point
+
+    arm_from_env()
+
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving.engine import ServingEngine
+    from dlrover_tpu.serving.fleet.replica import serve_step, serve_submit
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    engine = ServingEngine(
+        cfg, params,
+        slots=args.slots,
+        max_len=args.max_len,
+        prefill_chunk=args.prefill_chunk,
+    )
+    engine.warmup()
+
+    commands: "queue.Queue[dict]" = queue.Queue()
+    reader = threading.Thread(
+        target=_read_commands, args=(commands,), daemon=True
+    )
+    reader.start()
+
+    _emit({"kind": "ready", "replica": args.replica_id,
+           "pid": os.getpid()})
+    by_rid = {}  # engine rid -> (request_id, attempt)
+    last_hb = 0.0
+    while True:
+        now = time.monotonic()
+        if now - last_hb >= args.heartbeat_s:
+            try:
+                fault_point(
+                    "fleet.health.heartbeat", replica=args.replica_id
+                )
+                _emit({"kind": "heartbeat", "replica": args.replica_id})
+                last_hb = now
+            except Exception:
+                last_hb = now  # dropped beat; try again next window
+        try:
+            cmd = commands.get(
+                timeout=0.0 if engine.pending() else 0.02
+            )
+        except queue.Empty:
+            cmd = None
+        if cmd is not None:
+            if cmd.get("op") == "stop":
+                return 0
+            if cmd.get("op") == "submit":
+                serve_submit(
+                    engine, by_rid, _emit,
+                    cmd["request_id"], cmd.get("attempt", 0),
+                    cmd["prompt"], cmd["max_new_tokens"],
+                    cmd.get("temperature", 0.0), cmd.get("deadline_s"),
+                )
+        if engine.pending():
+            # The chaos episode's SIGKILL-mid-decode lands here: a
+            # ``crash`` rule on fleet.replica.step fires between two
+            # engine iterations with requests live in slots.
+            fault_point("fleet.replica.step", replica=args.replica_id)
+            if args.step_delay_ms > 0:
+                time.sleep(args.step_delay_ms / 1000.0)
+            serve_step(engine, by_rid, _emit)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,773 @@
+"""Health-gated request router over N serving replicas.
+
+The fleet front door (ROADMAP item 1's robustness half): requests enter
+here, replicas die/stall/overload behind it, and the contract to the
+caller stays simple — **every accepted request completes or is
+explicitly failed exactly once**. The pieces:
+
+- **Health-gated least-loaded dispatch.** Each replica sits behind a
+  :class:`~dlrover_tpu.serving.fleet.health.ReplicaHealth` breaker;
+  dispatch picks the least-loaded replica the breaker admits (HEALTHY
+  before SUSPECT before HALF_OPEN probes). One seeded RNG drives all
+  jitter, so a router run is as reproducible as a fault schedule.
+- **Deadlines.** A per-request TTL is checked at admission, at every
+  pump, and at dispatch; the REMAINING budget is propagated into the
+  replica's scheduler (satellite: `Scheduler.shed_expired`), so a dead
+  client's request cannot occupy a slot anywhere in the fleet.
+- **Bounded jittered retries.** A failed attempt (replica error,
+  dispatch fault, replica death) re-dispatches to a *different* replica
+  after an exponential jittered backoff, at most ``max_retries`` times;
+  exhaustion is an explicit terminal failure carrying the last
+  machine-readable reason.
+- **At-most-once completion.** The stable ``request_id`` keys a result
+  table; the first completion wins and every later one (hedge twin,
+  reclaimed-but-alive attempt, replayed wire event) is dropped and
+  counted in ``fleet_duplicate_completions_total``.
+- **Hedging.** A short request (``max_new <= hedge_max_new_tokens``)
+  whose sole attempt has been out longer than the observed service-
+  latency percentile gets a speculative duplicate on a different
+  replica — tail latency protection that the at-most-once table makes
+  safe.
+- **Load shedding.** Admission beyond ``max_queue`` returns an explicit
+  overload result immediately — the router never queues unboundedly.
+- **Crash re-routing.** A replica whose breaker enters BROKEN (process
+  exit, poisoned thread, missed heartbeats) has its in-flight ledger
+  reclaimed — `Scheduler.requeue_active` semantics lifted to the fleet:
+  victims re-queue at the FRONT in submit order and re-dispatch
+  elsewhere. The router restarts dead replicas after the breaker's
+  cooldown and re-admits them through half-open probes.
+
+The router is single-threaded by design: every structure is owned by
+the pump (``step()``), driven by the caller or by ``serve_forever``-
+style loops; replicas do their work on their own threads/processes and
+communicate only through their mailboxes. With an injected clock and
+fake replicas the whole policy surface is unit-testable without sleeps.
+"""
+
+import random
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import fault_point
+from dlrover_tpu.serving.fleet import health as health_lib
+from dlrover_tpu.serving.fleet.metrics import fleet_metrics
+from dlrover_tpu.serving.fleet.replica import ReplicaDeadError, WorkItem
+
+
+@dataclass
+class RouterConfig:
+    max_queue: int = 256            # admission bound (queued + waiting)
+    max_retries: int = 2            # re-dispatches after failed attempts
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter_frac: float = 0.3
+    hedge_enabled: bool = False     # optional speculative duplicates
+    hedge_max_new_tokens: int = 16  # only short requests hedge
+    hedge_after_s: Optional[float] = None   # None = adaptive percentile
+    hedge_percentile: float = 95.0
+    hedge_min_after_s: float = 0.25
+    hedge_min_samples: int = 8      # latencies before adaptive hedging
+    default_deadline_s: Optional[float] = None
+    max_done_retained: int = 4096   # terminal requests kept for results()
+    auto_restart: bool = True       # respawn dead replicas post-cooldown
+    # A freshly restarted replica is silent while it boots (subprocess
+    # JAX init + warmup can take many seconds): give it this long
+    # before heartbeat silence reads as "wedged, restart again" — or a
+    # slow boot becomes an infinite restart loop.
+    restart_boot_grace_s: float = 30.0
+    seed: int = 0
+    health: health_lib.HealthPolicy = field(
+        default_factory=health_lib.HealthPolicy
+    )
+
+
+@dataclass
+class FleetResult:
+    request_id: str
+    ok: bool
+    tokens: List[int] = field(default_factory=list)
+    truncated: bool = False
+    failure_reason: str = ""
+    replica_id: str = ""
+    attempts: int = 0
+    retries: int = 0
+    hedged: bool = False
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+
+
+@dataclass
+class FleetRequest:
+    """Router-side request state. ``request_id`` is stable across every
+    retry/hedge — it IS the at-most-once key."""
+
+    request_id: str
+    seq: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    deadline: Optional[float] = None      # absolute, router clock
+    submit_t: float = 0.0
+    accepted: bool = True
+    attempt_seq: int = 0                  # next attempt number
+    failed_attempts: int = 0
+    hedged: bool = False
+    first_dispatch_t: Optional[float] = None
+    # attempt -> (replica_id, dispatch_t, is_probe)
+    live_attempts: Dict[int, Tuple[str, float, bool]] = field(
+        default_factory=dict
+    )
+    tried_replicas: set = field(default_factory=set)
+    result: Optional[FleetResult] = None
+
+    @property
+    def done(self) -> bool:
+        return self.result is not None
+
+
+class FleetRouter:
+    """See module docstring. Not thread-safe: one pump drives it."""
+
+    def __init__(
+        self,
+        replicas: List,
+        config: Optional[RouterConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        registry=None,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.config = config or RouterConfig()
+        self._clock = clock
+        self.metrics = fleet_metrics(registry)
+        self._replicas = {r.replica_id: r for r in replicas}
+        if len(self._replicas) != len(replicas):
+            raise ValueError("duplicate replica_id in fleet")
+        self._health: Dict[str, health_lib.ReplicaHealth] = {}
+        for rid in self._replicas:
+            self._health[rid] = health_lib.ReplicaHealth(
+                rid,
+                policy=self.config.health,
+                clock=clock,
+                on_transition=self._make_transition_hook(rid),
+            )
+            self.metrics.replica_state.set(0, replica=rid)
+        self._queue: Deque[FleetRequest] = deque()
+        self._waiting: List[Tuple[float, FleetRequest]] = []
+        # replica_id -> {(request_id, attempt) -> FleetRequest}
+        self._ledger: Dict[str, Dict[Tuple[str, int], FleetRequest]] = {
+            rid: {} for rid in self._replicas
+        }
+        self._requests: Dict[str, FleetRequest] = {}
+        # Terminal requests in completion order; bounds _requests so a
+        # long-lived router does not grow RSS with every request ever
+        # served (callers keep their own FleetRequest handles).
+        self._done_order: Deque[str] = deque()
+        self._live_accepted = 0   # accepted, no terminal result yet
+        self._last_restart: Dict[str, float] = {}
+        self._service_lat: Deque[float] = deque(maxlen=256)
+        self._rng = random.Random(self.config.seed)
+        self._seq = 0
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True,
+              timeout_s: float = 120.0) -> None:
+        for replica in self._replicas.values():
+            replica.start()
+        if wait_ready:
+            deadline = self._clock() + timeout_s
+            for replica in self._replicas.values():
+                left = max(0.1, deadline - self._clock())
+                if not replica.wait_ready(left):
+                    logger.warning(
+                        "replica %s not ready within %.0fs",
+                        replica.replica_id, timeout_s,
+                    )
+        now = self._clock()
+        for h in self._health.values():
+            h.observe_heartbeat(now)
+
+    def stop(self) -> None:
+        for replica in self._replicas.values():
+            try:
+                replica.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+    # ---- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        deadline_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> FleetRequest:
+        now = self._clock()
+        self._seq += 1
+        if request_id is None:
+            request_id = f"req-{self._seq}"
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            # Same contract as Scheduler.submit: 0 must not silently
+            # mean "no deadline" — that is the opposite of the intent.
+            raise ValueError("deadline_s must be positive")
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        req = FleetRequest(
+            request_id=request_id,
+            seq=self._seq,
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            deadline=(
+                now + deadline_s if deadline_s is not None else None
+            ),
+            submit_t=now,
+        )
+        self._requests[request_id] = req
+        if len(self._queue) + len(self._waiting) >= self.config.max_queue:
+            # Explicit overload result, never an unbounded queue: the
+            # caller can back off / balance, a dead queue cannot.
+            req.accepted = False
+            req.result = FleetResult(
+                request_id=request_id, ok=False,
+                failure_reason="overload",
+            )
+            self.metrics.sheds.inc(reason="overload")
+            self.metrics.requests.inc(outcome="shed")
+            self.metrics.failures.inc(reason="overload")
+            self._retain_done(request_id)
+            return req
+        self.metrics.requests.inc(outcome="accepted")
+        self._live_accepted += 1
+        self._queue.append(req)
+        self.metrics.queue_depth.set(
+            len(self._queue) + len(self._waiting)
+        )
+        return req
+
+    # ---- the pump ----------------------------------------------------------
+
+    def step(self) -> List[FleetRequest]:
+        """One router iteration: drain replica mailboxes, advance
+        health, reclaim/re-route, shed expired, dispatch, hedge.
+        Returns requests that became terminal THIS call."""
+        now = self._clock()
+        newly_done: List[FleetRequest] = []
+        self._drain_replicas(now, newly_done)
+        self._check_replicas(now, newly_done)
+        # restart() above can block for seconds (subprocess teardown):
+        # deadline math below must not run on a stale clock or expired
+        # requests dispatch with phantom budget.
+        now = self._clock()
+        self._promote_waiting(now)
+        self._shed_expired(now, newly_done)
+        self._dispatch_queued(now, newly_done)
+        if self.config.hedge_enabled:
+            self._hedge_sweep(now, newly_done)
+        self.metrics.queue_depth.set(
+            len(self._queue) + len(self._waiting)
+        )
+        self.metrics.inflight.set(
+            sum(len(led) for led in self._ledger.values())
+        )
+        # State reads only — dispatchable(now) would flip a cooled-down
+        # BROKEN breaker to HALF_OPEN as a side effect.
+        self.metrics.replicas_dispatchable.set(sum(
+            1 for rid, replica in self._replicas.items()
+            if replica.alive()
+            and self._health[rid].state != health_lib.BROKEN
+        ))
+        return newly_done
+
+    def pending(self) -> int:
+        """Accepted requests without a terminal result. O(1): this is
+        polled every pump by run_until_idle and the soak/bench loops,
+        and _requests retains up to max_done_retained terminal entries."""
+        return self._live_accepted
+
+    def run_until_idle(self, timeout_s: float = 120.0,
+                       idle_sleep_s: float = 0.002) -> List[FleetRequest]:
+        """Pump until nothing is pending (or timeout); returns every
+        request that went terminal during the run."""
+        done: List[FleetRequest] = []
+        deadline = time.monotonic() + timeout_s
+        while self.pending():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet did not drain within {timeout_s}s: "
+                    f"{self.pending()} pending"
+                )
+            got = self.step()
+            done.extend(got)
+            if not got:
+                time.sleep(idle_sleep_s)
+        return done
+
+    def results(self) -> Dict[str, FleetResult]:
+        return {
+            rid: r.result
+            for rid, r in self._requests.items()
+            if r.result is not None
+        }
+
+    def health_state(self, replica_id: str) -> str:
+        return self._health[str(replica_id)].state
+
+    # ---- completions -------------------------------------------------------
+
+    def _drain_replicas(self, now: float, newly_done: List[FleetRequest]):
+        for rid, replica in self._replicas.items():
+            self._health[rid].observe_heartbeat(replica.last_heartbeat())
+            for event in replica.poll():
+                if event.get("kind") != "done":
+                    continue
+                self._handle_completion(rid, event, now, newly_done)
+
+    def _handle_completion(self, rid: str, event: dict, now: float,
+                           newly_done: List[FleetRequest]):
+        request_id = event.get("request_id")
+        attempt = event.get("attempt", 0)
+        req = self._requests.get(request_id)
+        key = (request_id, attempt)
+        entry = self._ledger[rid].pop(key, None)
+        if req is None or req.done:
+            # At-most-once: the result table already holds this
+            # request's terminal outcome (hedge twin finished first, or
+            # the attempt was reclaimed and re-run elsewhere).
+            self.metrics.duplicates.inc()
+            return
+        live = req.live_attempts.pop(attempt, None)
+        if live is not None and live[2]:
+            self._health[rid].end_probe()
+        if entry is None and live is None:
+            # Attempt already reclaimed (the replica broke, its ledger
+            # was re-routed) but the request is still live elsewhere:
+            # stale evidence, not a completion — and not a duplicate,
+            # since no result has been recorded yet.
+            self.metrics.stale_completions.inc()
+            return
+        dispatch_t = live[1] if live is not None else req.submit_t
+        if event.get("ok"):
+            self._service_lat.append(max(0.0, now - dispatch_t))
+            self._health[rid].record_success()
+            self._record_result(req, FleetResult(
+                request_id=request_id,
+                ok=True,
+                tokens=list(event.get("tokens", ())),
+                truncated=bool(event.get("truncated")),
+                replica_id=rid,
+                attempts=req.attempt_seq,
+                retries=req.failed_attempts,
+                hedged=req.hedged,
+                ttft_s=(
+                    (dispatch_t - req.submit_t) + event["ttft_s"]
+                    if event.get("ttft_s") is not None else None
+                ),
+                latency_s=now - req.submit_t,
+            ), newly_done)
+        else:
+            reason = event.get("failure_reason") or "replica_error"
+            if reason == "rejected":
+                # The engine's scheduler refused the request (prompt too
+                # long, no decode room): deterministic — every replica
+                # would reject it identically, so fail it now instead of
+                # burning retries, and strike nobody's breaker.
+                self._terminal_failure(req, reason, now, newly_done)
+                return
+            if reason != "deadline":
+                # A replica shedding an expired request is the replica
+                # WORKING (client-side condition) — only real errors
+                # strike its breaker.
+                self._health[rid].record_failure(reason)
+            self._attempt_failed(req, reason, now, newly_done)
+
+    def _record_result(self, req: FleetRequest, result: FleetResult,
+                       newly_done: List[FleetRequest]):
+        req.result = result
+        if req.accepted:
+            self._live_accepted -= 1
+        if result.ok:
+            self.metrics.requests.inc(outcome="completed")
+            if result.ttft_s is not None:
+                self.metrics.ttft.observe(result.ttft_s)
+            if result.latency_s is not None:
+                self.metrics.latency.observe(result.latency_s)
+        # Forget every other live attempt (hedge twin still computing
+        # somewhere): its eventual completion is a counted duplicate.
+        for attempt, (rid, _t, is_probe) in list(req.live_attempts.items()):
+            self._ledger[rid].pop((req.request_id, attempt), None)
+            if is_probe:
+                self._health[rid].end_probe()
+        req.live_attempts.clear()
+        newly_done.append(req)
+        self._retain_done(req.request_id)
+
+    def _retain_done(self, request_id: str) -> None:
+        self._done_order.append(request_id)
+        while len(self._done_order) > self.config.max_done_retained:
+            self._requests.pop(self._done_order.popleft(), None)
+
+    # ---- failure / retry ---------------------------------------------------
+
+    def _attempt_failed(self, req: FleetRequest, reason: str, now: float,
+                        newly_done: List[FleetRequest],
+                        immediate: bool = False):
+        """One attempt of ``req`` is gone (error, dispatch fault, or
+        replica death). Decide: wait for a live twin, retry elsewhere,
+        or terminal-fail with the machine-readable reason."""
+        req.failed_attempts += 1
+        if req.live_attempts:
+            return  # a hedge twin is still running; it may yet win
+        if req.deadline is not None and now > req.deadline:
+            self._terminal_failure(req, "deadline", now, newly_done)
+            return
+        if req.failed_attempts > self.config.max_retries:
+            self._terminal_failure(req, reason, now, newly_done)
+            return
+        self.metrics.retries.inc()
+        if immediate:
+            # Crash re-route: no backoff (the failure was the replica,
+            # not the request) — FRONT of the queue, oldest first, the
+            # fleet analogue of Scheduler.requeue_active.
+            self._queue.appendleft(req)
+        else:
+            backoff = min(
+                self.config.retry_backoff_s
+                * (2 ** (req.failed_attempts - 1)),
+                self.config.retry_backoff_max_s,
+            )
+            jitter = self.config.retry_jitter_frac
+            backoff *= self._rng.uniform(1.0 - jitter, 1.0 + jitter)
+            self._waiting.append((now + backoff, req))
+
+    def _terminal_failure(self, req: FleetRequest, reason: str,
+                          now: float, newly_done: List[FleetRequest]):
+        self._record_result(req, FleetResult(
+            request_id=req.request_id,
+            ok=False,
+            failure_reason=reason,
+            attempts=req.attempt_seq,
+            retries=req.failed_attempts,
+            hedged=req.hedged,
+            latency_s=now - req.submit_t,
+        ), newly_done)
+        self.metrics.requests.inc(outcome="failed")
+        self.metrics.failures.inc(reason=reason)
+
+    # ---- health / reclaim --------------------------------------------------
+
+    def _make_transition_hook(self, rid: str):
+        def hook(old: str, new: str):
+            self.metrics.replica_state.set(
+                health_lib.STATE_CODE[new], replica=rid
+            )
+            self.metrics.health_transitions.inc(replica=rid, to=new)
+            logger.info(
+                "fleet replica %s health: %s -> %s", rid, old, new
+            )
+        return hook
+
+    def _check_replicas(self, now: float,
+                        newly_done: List[FleetRequest]):
+        for rid, replica in self._replicas.items():
+            h = self._health[rid]
+            if not replica.alive() and h.state != health_lib.BROKEN:
+                h.mark_dead(
+                    "process_exit" if replica.mode == "subprocess"
+                    else "thread_exit"
+                )
+            else:
+                h.check(now)
+            if h.state == health_lib.BROKEN and self._ledger[rid]:
+                self._reclaim(rid, now, newly_done)
+            # A BROKEN replica with stale heartbeats is WEDGED (hung in
+            # a step, not erroring): probes would only oscillate it
+            # BROKEN<->HALF_OPEN forever, so it gets the dead-replica
+            # remedy. A BROKEN-but-heartbeating replica recovers via
+            # probes instead.
+            wedged = (
+                h.state == health_lib.BROKEN
+                and h.heartbeat_age(now)
+                > self.config.health.heartbeat_timeout_s
+                and now - self._last_restart.get(rid, float("-inf"))
+                > self.config.restart_boot_grace_s
+            )
+            if (
+                self.config.auto_restart
+                and (not replica.alive() or wedged)
+                and h.cooldown_elapsed(now)
+                # BROKEN keeps its original _broken_since across a
+                # failed restart, so cooldown_elapsed stays true; pace
+                # respawns explicitly or a crash-on-start replica is
+                # forked on every pump.
+                and now - self._last_restart.get(rid, float("-inf"))
+                >= self.config.health.probe_cooldown_s
+            ):
+                logger.warning(
+                    "fleet replica %s %s past cooldown; restarting",
+                    rid, "wedged" if replica.alive() else "dead",
+                )
+                replica.restart()
+                self._last_restart[rid] = now
+                self.metrics.restarts.inc()
+                # Grace: strikes resume from the restart, and the
+                # HALF_OPEN flip happens at the next dispatch attempt.
+                h.observe_heartbeat(now)
+
+    def _reclaim(self, rid: str, now: float,
+                 newly_done: List[FleetRequest]):
+        """The fleet's `requeue_active`: pull every in-flight attempt
+        off a broken replica and re-route, front-of-queue, in submit
+        order."""
+        entries = list(self._ledger[rid].items())
+        self._ledger[rid].clear()
+        victims: List[FleetRequest] = []
+        for (request_id, attempt), req in entries:
+            if req.done:
+                continue
+            live = req.live_attempts.pop(attempt, None)
+            if live is not None and live[2]:
+                self._health[rid].end_probe()
+            victims.append(req)
+            self.metrics.reroutes.inc()
+        # Reversed submit order + appendleft = oldest ends up first;
+        # _attempt_failed(immediate=True) does the appendleft.
+        for req in sorted(victims, key=lambda r: r.seq, reverse=True):
+            self._attempt_failed(
+                req, "replica_death", now, newly_done, immediate=True
+            )
+
+    # ---- dispatch ----------------------------------------------------------
+
+    def _promote_waiting(self, now: float):
+        if not self._waiting:
+            return
+        still = []
+        ready = []
+        for not_before, req in self._waiting:
+            if req.done:
+                continue
+            (ready if now >= not_before else still).append(
+                (not_before, req)
+            )
+        self._waiting = still
+        for _t, req in sorted(ready, key=lambda e: e[1].seq):
+            self._queue.append(req)
+
+    def _shed_expired(self, now: float,
+                      newly_done: List[FleetRequest]):
+        for pool in (
+            list(self._queue),
+            [r for _t, r in self._waiting],
+        ):
+            expired = [
+                r for r in pool
+                if r.deadline is not None and now > r.deadline
+                and not r.done
+            ]
+            if not expired:
+                continue
+            for req in expired:
+                self.metrics.sheds.inc(reason="deadline")
+                self._terminal_failure(req, "deadline", now, newly_done)
+        if any(r.done for r in self._queue):
+            self._queue = deque(
+                r for r in self._queue if not r.done
+            )
+        if any(r.done for _t, r in self._waiting):
+            self._waiting = [
+                (t, r) for t, r in self._waiting if not r.done
+            ]
+
+    def _pick_replica(self, now: float, exclude=(),
+                      strict_exclude: bool = False) -> Optional[str]:
+        """Least-loaded among breaker-admitted replicas, preferring
+        HEALTHY over SUSPECT over HALF_OPEN, and replicas the request
+        has not tried. Returns None when nothing is dispatchable."""
+        rank = {
+            health_lib.HEALTHY: 0,
+            health_lib.SUSPECT: 1,
+            health_lib.HALF_OPEN: 2,
+        }
+
+        def candidates(excluded):
+            cands = []
+            for rid in self._replicas:
+                if rid in excluded:
+                    continue
+                if not self._replicas[rid].alive():
+                    # Checked BEFORE dispatchable(): a cooled-down dead
+                    # replica must neither flip to HALF_OPEN here nor
+                    # mask the fall-back to an already-tried live one.
+                    continue
+                if not self._replicas[rid].wait_ready(0.0):
+                    continue  # respawned, still booting
+                h = self._health[rid]
+                if not h.dispatchable(now):
+                    continue
+                cands.append(
+                    (rank[h.state], len(self._ledger[rid]), rid)
+                )
+            return cands
+
+        cands = candidates(set(exclude))
+        if not cands and exclude and not strict_exclude:
+            # Every untried replica is fenced; a retry on a previously
+            # tried one beats stalling forever.
+            cands = candidates(set())
+        if not cands:
+            return None
+        cands.sort()
+        return cands[0][2]
+
+    def _pick_probe_replica(self, now: float) -> Optional[str]:
+        """A HALF_OPEN (or cooled-down BROKEN) replica with a free
+        probe slot. Probes must be actively FED: least-loaded choice
+        alone would starve a recovering replica forever while any
+        healthy peer exists, so fresh requests canary it explicitly."""
+        for rid, replica in self._replicas.items():
+            h = self._health[rid]
+            if h.state not in (health_lib.BROKEN, health_lib.HALF_OPEN):
+                continue
+            if not replica.alive():
+                continue
+            if not replica.wait_ready(0.0):
+                # Respawned but still booting (JAX init + warmup): a
+                # probe now would just sit out the boot while healthy
+                # peers idle. Readiness is per-generation, so this
+                # self-clears once the replica announces ready.
+                continue
+            if h.dispatchable(now) and h.is_probe_dispatch():
+                return rid
+        return None
+
+    def _dispatch_queued(self, now: float,
+                         newly_done: List[FleetRequest]):
+        stalled: List[FleetRequest] = []
+        while self._queue:
+            req = self._queue.popleft()
+            if req.done:
+                continue
+            rid = None
+            if not req.failed_attempts:
+                # Only fresh requests canary a recovering replica —
+                # a retried request has already paid a failed attempt
+                # and goes to the best-known replica.
+                rid = self._pick_probe_replica(now)
+            if rid is None:
+                rid = self._pick_replica(
+                    now, exclude=req.tried_replicas
+                )
+            if rid is None:
+                stalled.append(req)
+                break
+            kind = "retry" if req.failed_attempts else "primary"
+            self._dispatch(req, rid, kind, now, newly_done)
+        # Preserve order for everything not dispatched this pump.
+        for req in reversed(stalled):
+            self._queue.appendleft(req)
+
+    def _dispatch(self, req: FleetRequest, rid: str, kind: str,
+                  now: float, newly_done: List[FleetRequest]) -> bool:
+        h = self._health[rid]
+        is_probe = h.is_probe_dispatch()
+        attempt = req.attempt_seq
+        deadline_s = None
+        if req.deadline is not None:
+            deadline_s = max(0.001, req.deadline - now)
+        item = WorkItem(
+            request_id=req.request_id,
+            attempt=attempt,
+            prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            deadline_s=deadline_s,
+        )
+        try:
+            fault_point(
+                "fleet.router.dispatch",
+                replica=rid, request=req.request_id,
+            )
+            self._replicas[rid].submit(item)
+        except Exception as e:  # noqa: BLE001 — ReplicaDeadError,
+            # injected dispatch faults, broken pipes: all one path.
+            h.record_failure(f"dispatch:{type(e).__name__}")
+            # The replica was tried and failed us — without this the
+            # retry's least-loaded sort can deterministically pick the
+            # SAME replica again (rank/load ties break on rid).
+            req.tried_replicas.add(rid)
+            if kind == "hedge":
+                # The primary attempt is live and untouched: a hedge
+                # that never dispatched cancels itself without charging
+                # the request's retry budget.
+                return False
+            self._attempt_failed(
+                req, "dispatch_error", now, newly_done
+            )
+            return False
+        req.attempt_seq += 1
+        req.tried_replicas.add(rid)
+        if req.first_dispatch_t is None:
+            req.first_dispatch_t = now
+            self.metrics.queue_wait.observe(now - req.submit_t)
+        if is_probe:
+            h.begin_probe()
+        req.live_attempts[attempt] = (rid, now, is_probe)
+        self._ledger[rid][(req.request_id, attempt)] = req
+        self.metrics.dispatches.inc(kind=kind)
+        return True
+
+    # ---- hedging -----------------------------------------------------------
+
+    def _hedge_threshold(self) -> Optional[float]:
+        if self.config.hedge_after_s is not None:
+            return self.config.hedge_after_s
+        if len(self._service_lat) < self.config.hedge_min_samples:
+            return None
+        pct = float(np.percentile(
+            np.asarray(self._service_lat), self.config.hedge_percentile
+        ))
+        return max(self.config.hedge_min_after_s, pct)
+
+    def _hedge_sweep(self, now: float, newly_done: List[FleetRequest]):
+        threshold = self._hedge_threshold()
+        if threshold is None:
+            return
+        # Snapshot: dispatching mutates ledgers.
+        inflight = {
+            req.request_id: req
+            for led in self._ledger.values()
+            for req in led.values()
+        }
+        for req in inflight.values():
+            if (
+                req.done
+                or req.hedged
+                or len(req.live_attempts) != 1
+                or req.max_new_tokens > self.config.hedge_max_new_tokens
+            ):
+                continue
+            (rid, dispatch_t, _probe) = next(
+                iter(req.live_attempts.values())
+            )
+            if now - dispatch_t <= threshold:
+                continue
+            other = self._pick_replica(
+                now, exclude={rid}, strict_exclude=True
+            )
+            if other is None:
+                continue
+            if self._dispatch(req, other, "hedge", now, newly_done):
+                req.hedged = True
+                self.metrics.hedges.inc()

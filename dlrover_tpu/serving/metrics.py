@@ -63,6 +63,18 @@ class ServingMetrics:
             "engine iterations that raised and re-queued their in-flight "
             "requests",
         )
+        self.shed = reg.counter(
+            "serving_requests_shed_total",
+            "queued requests dropped before admission, by reason "
+            '(reason="deadline": past their TTL, never prefillled)',
+            labelnames=("reason",),
+        )
+        self.failures = reg.counter(
+            "serving_requests_failed_total",
+            "terminally failed requests by machine-readable reason "
+            "(requeue_budget, deadline, ...)",
+            labelnames=("reason",),
+        )
         self.ttft = reg.histogram(
             "serving_ttft_seconds",
             "submit-to-first-token latency",

@@ -48,8 +48,16 @@ class Request:
     tokens: List[int] = field(default_factory=list)
     truncated: bool = False            # hit max_len before max_new_tokens
     failed: bool = False               # explicitly failed (requeue budget)
+    # Machine-readable terminal failure reason ("" while not failed):
+    # "requeue_budget" (step-error restarts exhausted), "deadline"
+    # (shed from the queue past its TTL), or a caller-supplied reason.
+    failure_reason: str = ""
     requeues: int = 0                  # step-error restarts of this request
     submit_ts: float = 0.0
+    # Absolute deadline on the submit clock; a QUEUED request past it is
+    # shed (never admitted to prefill) — a dead client's request must
+    # not occupy a slot. None = no TTL.
+    deadline: Optional[float] = None
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None
 
@@ -101,6 +109,7 @@ class Scheduler:
         max_new_tokens: int,
         temperature: float = 0.0,
         now: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
@@ -112,15 +121,45 @@ class Scheduler:
                 f"prompt_len {prompt.shape[0]} leaves no decode room in "
                 f"max_len {self.max_len}"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
+        submit_ts = now if now is not None else time.monotonic()
         req = Request(
             rid=next(self._rid),
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             temperature=float(temperature),
-            submit_ts=now if now is not None else time.monotonic(),
+            submit_ts=submit_ts,
+            deadline=(
+                submit_ts + deadline_s if deadline_s is not None else None
+            ),
         )
         self.queue.append(req)
         return req
+
+    def shed_expired(self, now: Optional[float] = None) -> List[Request]:
+        """Drop QUEUED requests past their deadline — they are never
+        admitted to prefill, so a dead client's request cannot occupy a
+        slot. In-slot requests are untouched: their KV investment is
+        sunk and they finish on their own. Shed requests land in DONE
+        with ``failed=True`` / ``failure_reason="deadline"`` so callers
+        see an explicit terminal outcome, never silence."""
+        if now is None:
+            now = time.monotonic()
+        shed: List[Request] = []
+        kept: Deque[Request] = deque()
+        for req in self.queue:
+            if req.deadline is not None and now > req.deadline:
+                req.state = DONE
+                req.failed = True
+                req.failure_reason = "deadline"
+                req.finish_ts = now
+                shed.append(req)
+            else:
+                kept.append(req)
+        if shed:
+            self.queue = kept
+        return shed
 
     def admit(self) -> List[Request]:
         """Bind queued requests to free slots (FCFS). Under drain_mode,

@@ -1,0 +1,352 @@
+"""Fleet chaos episode: replica SIGKILL mid-decode, re-route, recover.
+
+The ``replica_kill_reroute`` episode kind (chaos soak episode 4): a
+:class:`~dlrover_tpu.serving.fleet.router.FleetRouter` over N
+subprocess replicas serves a seeded Poisson-ish request stream while a
+deterministic fault schedule SIGKILLs one replica between engine
+iterations with requests live in its slots (``fleet.replica.step``
+crash rule, armed through the standard env rigging so the fault trace
+survives the kill). After the stream drains, the **fleet invariant** is
+asserted:
+
+    every accepted request completes or is explicitly failed exactly
+    once — zero duplicate completions, zero silently lost — and the
+    router's health FSM marked the killed replica BROKEN then re-
+    admitted it after half-open probes succeeded.
+
+Randomness lives in plan generation (`random.Random(seed, episode)`),
+kill timing in the deterministic hit counter — one seed reproduces one
+episode, the PR-5 contract.
+"""
+
+import os
+import random
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fault import FaultRule, FaultSchedule, arm, disarm
+from dlrover_tpu.serving.fleet import (
+    BROKEN,
+    HALF_OPEN,
+    HEALTHY,
+    FleetRouter,
+    HealthPolicy,
+    RouterConfig,
+    SubprocessReplica,
+)
+from dlrover_tpu.testing.soak import SoakInvariantError, _read_trace
+
+
+@dataclass
+class FleetSoakConfig:
+    replicas: int = 2
+    requests: int = 12
+    new_tokens_short: int = 4
+    new_tokens_long: int = 10
+    slots: int = 2
+    max_len: int = 64
+    prefill_chunk: int = 8
+    watchdog_s: float = 180.0
+    keep_artifacts_on_success: bool = False
+
+
+def build_fleet_schedules(
+    seed: int, episode: int, cfg: Optional[FleetSoakConfig] = None
+) -> Dict[str, FaultSchedule]:
+    """Deterministic per-replica schedules for (seed, episode): the
+    victim replica is SIGKILLed on its Nth serve-loop iteration WITH
+    work pending (the fault point sits inside the ``engine.pending()``
+    branch, so hit N always lands mid-decode)."""
+    cfg = cfg or FleetSoakConfig()
+    ep_seed = seed * 10007 + episode
+    rng = random.Random(ep_seed ^ 0xF1EE7)
+    victim = str(rng.randrange(cfg.replicas))
+    # Late enough that requests are decoding, early enough that the
+    # kill always fires before the stream drains.
+    kill_nth = rng.randint(4, 10)
+    schedules = {
+        victim: FaultSchedule([
+            FaultRule("fleet.replica.step", action="crash",
+                      nth=kill_nth, rule_id="replica-sigkill"),
+        ], seed=ep_seed, label=f"replica{victim}"),
+    }
+    return schedules
+
+
+def run_fleet_episode(
+    seed: int,
+    episode: int = 4,
+    cfg: Optional[FleetSoakConfig] = None,
+    work_dir: Optional[str] = None,
+    artifact_dir: Optional[str] = None,
+    runner_schedule: Optional[FaultSchedule] = None,
+) -> Dict:
+    """One replica_kill_reroute episode; returns a soak-shaped report.
+    Raises SoakInvariantError (artifacts kept) on violation."""
+    import tempfile
+
+    cfg = cfg or FleetSoakConfig()
+    work_dir = work_dir or tempfile.mkdtemp(prefix="dlrover_fleet_")
+    artifact_dir = artifact_dir or os.path.join(work_dir, "artifacts")
+    ep_dir = os.path.join(work_dir, f"fleet-s{seed}-e{episode}")
+    shutil.rmtree(ep_dir, ignore_errors=True)
+    os.makedirs(ep_dir, exist_ok=True)
+    ep_seed = seed * 10007 + episode
+    rng = random.Random(ep_seed ^ 0x5EED)
+    schedules = build_fleet_schedules(seed, episode, cfg)
+    victim = next(iter(schedules))
+
+    schedule_paths: Dict[str, str] = {}
+    for rid, sched in schedules.items():
+        path = os.path.join(ep_dir, f"schedule_replica{rid}.json")
+        with open(path, "w") as f:
+            f.write(sched.to_json())
+        schedule_paths[rid] = path
+
+    from dlrover_tpu.observability.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    replicas = [
+        SubprocessReplica(
+            str(i), ep_dir,
+            slots=cfg.slots, max_len=cfg.max_len,
+            prefill_chunk=cfg.prefill_chunk,
+            # Per-generation: the victim's SIGKILL schedule arms only
+            # generation 0 — its post-restart generations run clean, so
+            # the half-open probes can actually succeed.
+            schedule_path=(
+                [schedule_paths[str(i)]]
+                if str(i) in schedule_paths else ""
+            ),
+        )
+        for i in range(cfg.replicas)
+    ]
+    router = FleetRouter(
+        replicas,
+        RouterConfig(
+            max_retries=3,
+            seed=ep_seed,
+            health=HealthPolicy(
+                heartbeat_timeout_s=2.0,
+                probe_cooldown_s=0.5,
+                probe_successes=2,
+            ),
+        ),
+        registry=registry,
+    )
+    if runner_schedule is not None:
+        arm(runner_schedule)
+
+    health_seen = {rid: set() for rid in router._replicas}  # noqa: SLF001
+
+    def note_health():
+        for rid in health_seen:
+            health_seen[rid].add(router.health_state(rid))
+
+    t_start = time.time()
+    deadline = t_start + cfg.watchdog_s
+    accepted: List = []
+    failure: Optional[str] = None
+    vocab_hi = 100  # tiny llama vocab is larger; any id >= 1 works
+    try:
+        router.start(timeout_s=min(120.0, cfg.watchdog_s))
+        to_submit = [
+            (
+                [rng.randint(1, vocab_hi) for _ in
+                 range(rng.randint(4, 10))],
+                cfg.new_tokens_long if rng.random() < 0.5
+                else cfg.new_tokens_short,
+            )
+            for _ in range(cfg.requests)
+        ]
+        while to_submit or router.pending():
+            if time.time() > deadline:
+                failure = "watchdog: fleet episode deadline exceeded"
+                break
+            if to_submit:
+                prompt, new = to_submit.pop(0)
+                accepted.append(router.submit(prompt, new))
+            router.step()
+            note_health()
+            time.sleep(0.005)
+        # Recovery half: keep trickling traffic until the victim's
+        # breaker walks BROKEN -> HALF_OPEN -> HEALTHY again.
+        while not failure and router.health_state(victim) != HEALTHY:
+            if time.time() > deadline:
+                failure = (
+                    f"watchdog: victim replica {victim} never "
+                    f"re-admitted (stuck {router.health_state(victim)})"
+                )
+                break
+            if router.pending() == 0:
+                accepted.append(router.submit(
+                    [rng.randint(1, vocab_hi) for _ in range(5)],
+                    cfg.new_tokens_short,
+                ))
+            router.step()
+            note_health()
+            time.sleep(0.005)
+        if not failure:
+            try:
+                router.run_until_idle(
+                    timeout_s=max(1.0, deadline - time.time())
+                )
+            except TimeoutError as e:
+                failure = f"watchdog: {e}"
+    finally:
+        if runner_schedule is not None:
+            disarm()
+        router.stop()
+
+    wall = time.time() - t_start
+    report: Dict = {
+        "episode": episode,
+        "seed": seed,
+        "kind": "replica_kill_reroute",
+        "wall_s": round(wall, 3),
+        "victim": victim,
+        "requests": len(accepted),
+    }
+    try:
+        if failure:
+            raise SoakInvariantError(failure)
+        _check_fleet_invariant(
+            accepted, router, registry, victim, health_seen
+        )
+    except SoakInvariantError as e:
+        dest = _dump_artifacts(
+            ep_dir, artifact_dir, schedules, seed, episode, str(e)
+        )
+        logger.error(
+            "FLEET EPISODE FAILED: %s\n  artifacts: %s", e, dest
+        )
+        raise
+    # ---- goodput-shaped accounting (soak report schema) ---------------
+    results = [r.result for r in accepted if r.result is not None]
+    completed = [r for r in results if r.ok]
+    report.update({
+        "productive_step_s": round(sum(
+            r.latency_s or 0.0 for r in completed
+        ), 3),
+        "goodput_frac": round(
+            len(completed) / max(len(results), 1), 4
+        ),
+        "completed": len(completed),
+        "failed": len(results) - len(completed),
+        "reroutes": int(
+            registry.get("fleet_reroutes_total").value()
+        ),
+        "retries": int(registry.get("fleet_retries_total").value()),
+        "duplicates": int(
+            registry.get("fleet_duplicate_completions_total").value()
+        ),
+        "stale": int(
+            registry.get("fleet_stale_completions_total").value()
+        ),
+        "restarts": int(
+            registry.get("fleet_replica_restarts_total").value()
+        ),
+        "deaths": 1,
+        "recovery_s": [],
+        "steps_unique": len(completed),
+        "steps_executed": len(results),
+        "faults": [
+            t
+            for rid in schedules
+            for t in _read_trace(
+                os.path.join(ep_dir, f"trace_replica{rid}.jsonl"),
+                f"replica{rid}",
+            )
+        ],
+    })
+    if not cfg.keep_artifacts_on_success:
+        shutil.rmtree(ep_dir, ignore_errors=True)
+    return report
+
+
+def _check_fleet_invariant(accepted, router, registry, victim,
+                           health_seen):
+    """Every accepted request: exactly one terminal result; victim
+    walked BROKEN -> HALF_OPEN -> HEALTHY; the fault actually fired."""
+    silent = [
+        r.request_id for r in accepted
+        if r.accepted and r.result is None
+    ]
+    if silent:
+        raise SoakInvariantError(
+            f"fleet requests neither completed nor explicitly failed: "
+            f"{silent}"
+        )
+    # Exactly-once is structural (one result slot per request_id); what
+    # can drift is a completion recorded twice into metrics. Cross-check
+    # the counters: completed + failed == terminal results.
+    results = [r.result for r in accepted if r.result is not None]
+    ok = sum(1 for r in results if r.ok)
+    failed = sum(1 for r in results if not r.ok)
+    m_completed = registry.get("fleet_requests_total").value(
+        outcome="completed"
+    )
+    m_failed = registry.get("fleet_requests_total").value(
+        outcome="failed"
+    )
+    m_shed = registry.get("fleet_requests_total").value(outcome="shed")
+    if m_completed != ok or m_failed + m_shed != failed:
+        raise SoakInvariantError(
+            f"completion accounting drift: results ok={ok} "
+            f"failed={failed} vs metrics completed={m_completed} "
+            f"failed={m_failed} shed={m_shed} — a duplicate or lost "
+            f"record"
+        )
+    for r in results:
+        if not r.ok and not r.failure_reason:
+            raise SoakInvariantError(
+                f"request {r.request_id} failed without a "
+                f"machine-readable reason"
+            )
+    seen = health_seen[victim]
+    if BROKEN not in seen:
+        raise SoakInvariantError(
+            f"victim replica {victim} was never marked broken "
+            f"(states seen: {sorted(seen)})"
+        )
+    if HALF_OPEN not in seen:
+        raise SoakInvariantError(
+            f"victim replica {victim} never reached half_open probes "
+            f"(states seen: {sorted(seen)})"
+        )
+    if router.health_state(victim) != HEALTHY:
+        raise SoakInvariantError(
+            f"victim replica {victim} not re-admitted: "
+            f"{router.health_state(victim)}"
+        )
+    if registry.get("fleet_replica_restarts_total").value() < 1:
+        raise SoakInvariantError("victim replica was never restarted")
+
+
+def _dump_artifacts(ep_dir, artifact_dir, schedules, seed, episode,
+                    reason) -> str:
+    import glob
+    import json
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    dest = os.path.join(artifact_dir, f"fleet_seed{seed}_ep{episode}")
+    shutil.rmtree(dest, ignore_errors=True)
+    os.makedirs(dest, exist_ok=True)
+    for src in glob.glob(os.path.join(ep_dir, "replica*_gen*.log")):
+        shutil.copy(src, dest)
+    for src in glob.glob(os.path.join(ep_dir, "trace_replica*.jsonl")):
+        shutil.copy(src, dest)
+    for rid, sched in schedules.items():
+        with open(
+            os.path.join(dest, f"schedule_replica{rid}.json"), "w"
+        ) as f:
+            f.write(sched.to_json())
+    with open(os.path.join(dest, "failure.json"), "w") as f:
+        json.dump({
+            "seed": seed, "episode": episode,
+            "kind": "replica_kill_reroute", "reason": reason,
+        }, f, indent=2)
+    return dest

@@ -55,6 +55,12 @@ EPISODE_KINDS = (
     # dlrover_tpu/testing/rescale_soak.py). Appended last so episodes
     # 0-2 keep their historical (seed, episode) -> plan identity.
     "kill_during_rescale",
+    # Episode 4: a serving-fleet replica is SIGKILLed mid-decode; the
+    # router must re-route its in-flight ledger (at-most-once), mark it
+    # broken, restart it, and re-admit it through half-open probes
+    # (delegated to dlrover_tpu/testing/fleet_soak.py). Appended so
+    # episodes 0-3 keep their (seed, episode) -> plan identity.
+    "replica_kill_reroute",
 )
 
 
@@ -169,6 +175,16 @@ def build_episode_plan(
                           nth=1, rule_id="shm-image-lost"),
             ], seed=ep_seed, label="gen1"),
         ]
+    elif kind == "replica_kill_reroute":
+        # The per-replica SIGKILL schedule is derived in
+        # fleet_soak.build_fleet_schedules (same ep_seed); the runner
+        # additionally drops one router dispatch on the wire so the
+        # bounded-retry path fires in the same episode.
+        runner_rules.append(FaultRule(
+            "fleet.router.dispatch", action="raise",
+            nth=rng.randint(2, 6),
+            rule_id="drop-router-dispatch",
+        ))
     elif kind == "kill_during_rescale":
         # Rank 1 dies mid-step (cuts the scale-down plan); rank 0 is
         # SIGKILLed in the restore-to-first-step window of THAT plan
@@ -477,6 +493,10 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
         return _run_rescale_kind(
             seed, episode, plan, cfg, work_dir, artifact_dir
         )
+    if plan.kind == "replica_kill_reroute":
+        return _run_fleet_kind(
+            seed, episode, plan, cfg, work_dir, artifact_dir
+        )
     ep_dir = os.path.join(work_dir, f"soak-s{seed}-e{episode}")
     shutil.rmtree(ep_dir, ignore_errors=True)
     os.makedirs(os.path.join(ep_dir, "flight"), exist_ok=True)
@@ -689,6 +709,39 @@ def _run_rescale_kind(seed, episode, plan, cfg, work_dir, artifact_dir):
         "generations": sum(g + 1 for g in gens.values()),
     })
     return report
+
+
+def _run_fleet_kind(seed, episode, plan, cfg, work_dir, artifact_dir):
+    """Episode kind 5: delegate to the serving-fleet harness — a
+    subprocess replica is SIGKILLed mid-decode, the router re-routes
+    its in-flight ledger and walks the victim's breaker back to
+    HEALTHY through half-open probes. The report is already
+    soak-shaped."""
+    from dlrover_tpu.testing.fleet_soak import (
+        FleetSoakConfig,
+        run_fleet_episode,
+    )
+
+    fcfg = FleetSoakConfig(
+        watchdog_s=cfg.watchdog_s,
+        keep_artifacts_on_success=cfg.keep_artifacts_on_success,
+    )
+    try:
+        return run_fleet_episode(
+            seed,
+            episode=episode,
+            cfg=fcfg,
+            work_dir=work_dir,
+            artifact_dir=artifact_dir,
+            runner_schedule=plan.runner_schedule,
+        )
+    except SoakInvariantError:
+        print(
+            f"  repro: python tools/chaos_soak.py --seed {seed} "
+            f"--episode {episode}",
+            file=sys.stderr, flush=True,
+        )
+        raise
 
 
 def run_soak(seed: int = 0, episodes: int = 3,

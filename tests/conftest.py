@@ -43,3 +43,8 @@ def pytest_configure(config):
         "rescale: live elastic N→M rescale protocol (plan broadcast, "
         "barrier, resharded restore) — docs/DESIGN.md §27",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: self-healing serving fleet (health-gated router, "
+        "retries/hedges, crash re-routing) — docs/DESIGN.md §28",
+    )

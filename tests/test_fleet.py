@@ -1,0 +1,861 @@
+"""Self-healing serving fleet: health FSM, router policy, satellites.
+
+Everything policy-level runs against FAKE replicas under an injected
+fake clock — no threads, no subprocesses, no sleeps — exactly the
+testing posture the breaker and router were designed for
+(docs/DESIGN.md §28). The subprocess/chaos path is covered by the
+slow-lane episode smoke at the bottom and by chaos_soak episode 4.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.observability.registry import MetricsRegistry
+from dlrover_tpu.serving.fleet import (
+    BROKEN,
+    HALF_OPEN,
+    HEALTHY,
+    SUSPECT,
+    FleetRouter,
+    HealthPolicy,
+    ReplicaDeadError,
+    ReplicaHealth,
+    RouterConfig,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Health FSM under a fake clock
+# ---------------------------------------------------------------------------
+
+
+def _health(clock, **policy):
+    defaults = dict(
+        suspect_after=2, broken_after=4,
+        heartbeat_timeout_s=2.0, probe_cooldown_s=1.0,
+        probe_successes=2,
+    )
+    defaults.update(policy)
+    return ReplicaHealth("0", HealthPolicy(**defaults), clock=clock)
+
+
+@pytest.mark.fleet
+def test_health_full_cycle_healthy_to_healthy():
+    """healthy → suspect → broken → half_open → healthy, every
+    transition driven by explicit inputs and the injected clock."""
+    clock = FakeClock()
+    h = _health(clock)
+    assert h.state == HEALTHY
+    h.record_failure()
+    assert h.state == HEALTHY
+    h.record_failure()
+    assert h.state == SUSPECT
+    h.record_failure()
+    h.record_failure()
+    assert h.state == BROKEN
+    assert not h.dispatchable()          # quarantined
+    clock.advance(0.5)
+    assert not h.dispatchable()          # cooldown not elapsed
+    clock.advance(0.6)
+    assert h.dispatchable()              # flips to HALF_OPEN on demand
+    assert h.state == HALF_OPEN
+    h.record_success()
+    assert h.state == HALF_OPEN          # one probe is not enough
+    h.record_success()
+    assert h.state == HEALTHY
+    assert h.consecutive_failures == 0
+
+
+@pytest.mark.fleet
+def test_health_suspect_recovers_on_one_success():
+    clock = FakeClock()
+    h = _health(clock)
+    h.record_failure()
+    h.record_failure()
+    assert h.state == SUSPECT
+    assert h.dispatchable()              # suspect still takes traffic
+    h.record_success()
+    assert h.state == HEALTHY
+
+
+@pytest.mark.fleet
+def test_health_half_open_failure_slams_shut():
+    clock = FakeClock()
+    h = _health(clock)
+    h.mark_dead()
+    assert h.state == BROKEN
+    clock.advance(1.1)
+    assert h.dispatchable()
+    assert h.state == HALF_OPEN
+    h.record_failure()
+    assert h.state == BROKEN             # cooldown restarts
+    assert not h.dispatchable()
+    clock.advance(1.1)
+    assert h.dispatchable()
+    assert h.state == HALF_OPEN
+
+
+@pytest.mark.fleet
+def test_health_missed_heartbeats_strike_per_window():
+    """A stalled replica walks the same path as an erroring one: one
+    strike per elapsed heartbeat window, not one per check() call."""
+    clock = FakeClock()
+    h = _health(clock)
+    clock.advance(2.5)
+    h.check()
+    h.check()                            # same window: no double strike
+    assert h.consecutive_failures == 1
+    assert h.state == HEALTHY
+    clock.advance(2.0)
+    h.check()
+    assert h.state == SUSPECT
+    clock.advance(4.0)                   # two more windows at once
+    h.check()
+    assert h.state == BROKEN
+    assert h.last_failure_reason == "heartbeat"
+
+
+@pytest.mark.fleet
+def test_health_heartbeat_rearms_strike_window():
+    clock = FakeClock()
+    h = _health(clock)
+    clock.advance(1.9)
+    h.observe_heartbeat()
+    clock.advance(1.9)                   # 3.8s total, but beat at 1.9
+    h.check()
+    assert h.consecutive_failures == 0
+    assert h.state == HEALTHY
+
+
+@pytest.mark.fleet
+def test_health_probe_slots_bound_canaries():
+    clock = FakeClock()
+    h = _health(clock, max_probes_inflight=1)
+    h.mark_dead()
+    clock.advance(1.1)
+    assert h.dispatchable()
+    h.begin_probe()
+    assert not h.dispatchable()          # one canary at a time
+    h.end_probe()
+    assert h.dispatchable()
+
+
+# ---------------------------------------------------------------------------
+# Router policy against fake replicas
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """Mailbox test double: items accumulate in ``inbox``; tests emit
+    completions explicitly via complete()/fail()."""
+
+    mode = "fake"
+
+    def __init__(self, replica_id, clock):
+        self.replica_id = str(replica_id)
+        self._clock = clock
+        self.inbox = []
+        self.outbox = []
+        self.generation = 0
+        self.is_alive = True
+        self.beating = True
+        self.restarts = 0
+
+    def start(self):
+        self.is_alive = True
+
+    def wait_ready(self, timeout=0.0):
+        return True
+
+    def alive(self):
+        return self.is_alive
+
+    def kill(self):
+        self.is_alive = False
+        self.beating = False
+
+    def stop(self):
+        self.is_alive = False
+
+    def restart(self):
+        self.restarts += 1
+        self.generation += 1
+        self.inbox = []
+        self.is_alive = True
+        self.beating = True
+
+    def submit(self, item):
+        if not self.is_alive:
+            raise ReplicaDeadError(f"fake {self.replica_id} dead")
+        self.inbox.append(item)
+
+    def poll(self):
+        out, self.outbox = self.outbox, []
+        return out
+
+    def last_heartbeat(self):
+        return self._clock() if self.beating else 0.0
+
+    # -- test helpers --------------------------------------------------------
+
+    def take(self):
+        assert self.inbox, f"replica {self.replica_id} has no work"
+        return self.inbox.pop(0)
+
+    def complete(self, item, tokens=(1, 2), ttft_s=0.001):
+        self.outbox.append({
+            "kind": "done", "request_id": item.request_id,
+            "attempt": item.attempt, "ok": True,
+            "tokens": list(tokens), "truncated": False,
+            "failure_reason": "", "ttft_s": ttft_s,
+            "generation": self.generation,
+        })
+
+    def fail(self, item, reason="replica_error"):
+        self.outbox.append({
+            "kind": "done", "request_id": item.request_id,
+            "attempt": item.attempt, "ok": False, "tokens": [],
+            "truncated": False, "failure_reason": reason,
+            "ttft_s": None, "generation": self.generation,
+        })
+
+
+def _router(n=2, clock=None, **cfg_kw):
+    clock = clock or FakeClock()
+    cfg_kw.setdefault("retry_backoff_s", 0.1)
+    cfg_kw.setdefault("retry_jitter_frac", 0.0)
+    cfg_kw.setdefault(
+        "health",
+        HealthPolicy(heartbeat_timeout_s=5.0, probe_cooldown_s=1.0,
+                     probe_successes=1),
+    )
+    reps = [FakeReplica(i, clock) for i in range(n)]
+    router = FleetRouter(
+        reps, RouterConfig(**cfg_kw), clock=clock,
+        registry=MetricsRegistry(),
+    )
+    router.start()
+    return router, reps, clock
+
+
+@pytest.mark.fleet
+def test_router_least_loaded_dispatch():
+    router, (a, b), clock = _router()
+    router.submit([1, 2], 4)
+    router.submit([3, 4], 4)
+    router.step()
+    assert len(a.inbox) == 1 and len(b.inbox) == 1
+
+
+@pytest.mark.fleet
+def test_router_completion_roundtrip_and_ttft():
+    router, (a,), clock = _router(n=1)
+    req = router.submit([1, 2, 3], 4)
+    router.step()
+    item = a.take()
+    assert item.request_id == req.request_id
+    clock.advance(0.05)
+    a.complete(item, tokens=(7, 8, 9), ttft_s=0.01)
+    done = router.step()
+    assert [r.request_id for r in done] == [req.request_id]
+    assert req.result.ok and req.result.tokens == [7, 8, 9]
+    # Router TTFT = queue+dispatch wait plus the replica's own TTFT.
+    assert req.result.ttft_s == pytest.approx(0.01, abs=1e-9)
+
+
+@pytest.mark.fleet
+def test_router_retry_goes_to_a_different_replica():
+    router, (a, b), clock = _router()
+    req = router.submit([1, 2], 4)
+    router.step()
+    victim, other = (a, b) if a.inbox else (b, a)
+    victim.fail(victim.take())
+    router.step()                        # failure seen -> backoff queue
+    assert not other.inbox               # not re-dispatched yet
+    clock.advance(0.2)                   # past the jittered backoff
+    router.step()
+    item = other.take()                  # re-routed to the OTHER replica
+    assert item.request_id == req.request_id
+    assert item.attempt == 1
+    other.complete(item)
+    router.step()
+    assert req.result.ok
+    assert req.result.retries == 1
+    assert router.metrics.retries.value() == 1
+
+
+@pytest.mark.fleet
+def test_router_retry_budget_exhaustion_is_explicit():
+    router, (a,), clock = _router(
+        n=1, max_retries=1,
+        health=HealthPolicy(broken_after=10, heartbeat_timeout_s=60.0),
+    )
+    req = router.submit([1, 2], 4)
+    router.step()
+    a.fail(a.take(), reason="oom")
+    router.step()
+    clock.advance(0.2)
+    router.step()
+    a.fail(a.take(), reason="oom")
+    router.step()
+    assert req.result is not None and not req.result.ok
+    assert req.result.failure_reason == "oom"   # machine-readable
+    assert req.result.retries == 2
+    assert router.metrics.failures.value(reason="oom") == 1
+    assert router.metrics.requests.value(outcome="failed") == 1
+
+
+@pytest.mark.fleet
+def test_router_at_most_once_drops_duplicate_completions():
+    router, (a,), clock = _router(n=1)
+    req = router.submit([1, 2], 4)
+    router.step()
+    item = a.take()
+    a.complete(item, tokens=(5,))
+    a.complete(item, tokens=(6,))        # replayed wire event
+    router.step()
+    assert req.result.tokens == [5]      # first completion won
+    assert router.metrics.duplicates.value() == 1
+    assert router.metrics.requests.value(outcome="completed") == 1
+
+
+@pytest.mark.fleet
+def test_router_hedge_twin_first_wins_once():
+    router, (a, b), clock = _router(
+        hedge_enabled=True, hedge_after_s=0.5, hedge_max_new_tokens=8,
+    )
+    req = router.submit([1, 2], 4)
+    router.step()
+    primary, other = (a, b) if a.inbox else (b, a)
+    first = primary.take()
+    clock.advance(0.6)                   # past the hedge threshold
+    router.step()
+    twin = other.take()                  # speculative duplicate
+    assert twin.request_id == req.request_id
+    assert twin.attempt != first.attempt
+    assert req.hedged
+    assert router.metrics.hedges.value() == 1
+    other.complete(twin, tokens=(9,))
+    router.step()
+    assert req.result.ok and req.result.tokens == [9]
+    primary.complete(first, tokens=(1,))  # slow twin lands later
+    router.step()
+    assert req.result.tokens == [9]      # still the first result
+    assert router.metrics.duplicates.value() == 1
+    assert router.metrics.requests.value(outcome="completed") == 1
+
+
+@pytest.mark.fleet
+def test_router_hedge_skips_long_requests():
+    router, (a, b), clock = _router(
+        hedge_enabled=True, hedge_after_s=0.5, hedge_max_new_tokens=8,
+    )
+    router.submit([1, 2], 64)            # too long to hedge
+    router.step()
+    clock.advance(5.0)
+    router.step()
+    assert router.metrics.hedges.value() == 0
+
+
+@pytest.mark.fleet
+def test_router_overload_shed_is_immediate_and_explicit():
+    router, (a,), clock = _router(n=1, max_queue=2)
+    router.submit([1], 4)
+    router.submit([2], 4)
+    req = router.submit([3], 4)          # over the admission bound
+    assert not req.accepted
+    assert req.result is not None and not req.result.ok
+    assert req.result.failure_reason == "overload"
+    assert router.metrics.sheds.value(reason="overload") == 1
+    assert router.metrics.requests.value(outcome="shed") == 1
+
+
+@pytest.mark.fleet
+def test_router_deadline_sheds_queued_and_propagates_budget():
+    clock = FakeClock()
+    router, (a,), _ = _router(n=1, clock=clock, auto_restart=False)
+    # Fence the only replica so the first request must queue.
+    a.kill()
+    router.step()                        # mark_dead -> BROKEN
+    assert router.health_state("0") == BROKEN
+    req = router.submit([1, 2], 4, deadline_s=1.0)
+    router.step()
+    assert req.result is None            # queued, waiting for a replica
+    clock.advance(1.1)
+    done = router.step()
+    assert [r.request_id for r in done] == [req.request_id]
+    assert req.result.failure_reason == "deadline"
+    assert router.metrics.sheds.value(reason="deadline") == 1
+    # Remaining-budget propagation into the replica scheduler:
+    a.restart()
+    router.step()
+    req2 = router.submit([1, 2], 4, deadline_s=2.0)
+    clock.advance(0.5)
+    router.step()
+    item = a.take()
+    assert item.deadline_s == pytest.approx(1.5)
+
+
+@pytest.mark.fleet
+def test_router_crash_reclaims_ledger_and_reroutes():
+    """The fleet requeue_active: a replica dies with work in flight;
+    the router marks it broken, re-routes the victims to the peer in
+    submit order, restarts the corpse after cooldown, and re-admits it
+    through a half-open probe."""
+    router, (a, b), clock = _router()
+    r1 = router.submit([1, 2], 4)
+    r2 = router.submit([3, 4], 4)
+    router.step()
+    assert len(a.inbox) == 1 and len(b.inbox) == 1
+    a.kill()                             # dies with r's attempt in flight
+    router.step()
+    assert router.health_state("0") == BROKEN
+    victim = r1 if not a.inbox and r1.live_attempts else r1
+    # Both requests must end up with exactly one live attempt on b.
+    items = b.inbox
+    assert len(items) == 2               # original + re-routed
+    assert router.metrics.reroutes.value() == 1
+    for item in list(items):
+        b.complete(b.take())
+    router.step()
+    assert r1.result.ok and r2.result.ok
+    assert (
+        router.metrics.requests.value(outcome="completed") == 2
+    )
+    # Cooldown elapses -> auto restart -> probe re-admission.
+    clock.advance(1.1)
+    router.step()
+    assert a.restarts == 1
+    assert router.metrics.restarts.value() == 1
+    r3 = router.submit([5, 6], 4)
+    router.step()
+    assert router.health_state("0") == HALF_OPEN
+    probe = a.take()                     # fresh request canaries it
+    assert probe.request_id == r3.request_id
+    a.complete(probe)
+    router.step()
+    assert router.health_state("0") == HEALTHY
+    assert victim is r1
+
+
+@pytest.mark.fleet
+def test_router_replica_deadline_sheds_do_not_strike_health():
+    """A replica shedding expired requests is doing its job — the
+    sheds are a client-side condition and must not walk the replica's
+    breaker toward BROKEN."""
+    router, (a,), clock = _router(n=1)
+    for _ in range(6):                   # > broken_after
+        req = router.submit([1, 2], 4, deadline_s=0.5)
+        router.step()
+        item = a.take()
+        clock.advance(0.6)               # expires while in flight
+        a.fail(item, reason="deadline")
+        done = router.step()
+        assert [r.request_id for r in done] == [req.request_id]
+        assert req.result.failure_reason == "deadline"
+    assert router.health_state("0") == HEALTHY
+
+
+@pytest.mark.fleet
+def test_router_failed_hedge_dispatch_keeps_retry_budget():
+    """A hedge that cannot even dispatch cancels itself: the primary
+    attempt stays live with its full retry budget and the request is
+    not marked hedged."""
+    router, (a, b), clock = _router(
+        hedge_enabled=True, hedge_after_s=0.5, hedge_max_new_tokens=8,
+        max_retries=1,
+    )
+    req = router.submit([1, 2], 4)
+    router.step()
+    primary, other = (a, b) if a.inbox else (b, a)
+    item = primary.take()
+
+    def boom(_item):
+        raise RuntimeError("mailbox full")
+
+    other.submit = boom
+    clock.advance(0.6)                   # past the hedge threshold
+    router.step()                        # hedge dispatch fails
+    assert not req.hedged
+    assert req.failed_attempts == 0
+    assert router.metrics.hedges.value() == 0
+    primary.complete(item)
+    router.step()
+    assert req.result.ok
+
+
+@pytest.mark.fleet
+def test_router_restart_is_paced_by_cooldown():
+    """A replica that dies again right after each respawn is restarted
+    at most once per cooldown window, never on every pump."""
+    router, (a,), clock = _router(n=1)
+    a.kill()
+    router.step()
+    assert router.health_state("0") == BROKEN
+    clock.advance(1.1)
+    router.step()
+    assert a.restarts == 1
+    a.kill()                             # crash-on-start
+    for _ in range(5):
+        router.step()                    # same instant: no respawn storm
+    assert a.restarts == 1
+    clock.advance(1.1)
+    router.step()
+    assert a.restarts == 2
+
+
+@pytest.mark.fleet
+def test_router_restarts_wedged_but_alive_replica():
+    """A replica that hangs without exiting (alive, heartbeats stop)
+    must get the dead-replica remedy — probes alone would oscillate it
+    BROKEN<->HALF_OPEN forever."""
+    clock = FakeClock()
+    router, (a,), _ = _router(n=1, clock=clock)
+    a.beating = False                    # wedged: alive, no heartbeats
+    for _ in range(5):                   # > broken_after strike windows
+        clock.advance(5.1)
+        router.step()
+    assert a.is_alive
+    assert router.health_state("0") == BROKEN
+    assert a.restarts == 1               # restarted despite being alive
+    # Heartbeats resume post-restart; probes walk it back to HEALTHY.
+    router.submit([1, 2], 4)
+    router.step()
+    assert router.health_state("0") == HALF_OPEN
+    a.complete(a.take())
+    router.step()
+    assert router.health_state("0") == HEALTHY
+
+
+@pytest.mark.fleet
+def test_router_rejected_request_fails_terminal_without_strike():
+    """A scheduler rejection is deterministic: the router fails the
+    request immediately (no cross-fleet retry cascade) and the replica
+    that reported it takes no breaker strike."""
+    router, (a, b), clock = _router()
+    req = router.submit([1, 2], 4)
+    router.step()
+    primary, other = (a, b) if a.inbox else (b, a)
+    primary.fail(primary.take(), reason="rejected")
+    done = router.step()
+    assert [r.request_id for r in done] == [req.request_id]
+    assert not req.result.ok
+    assert req.result.failure_reason == "rejected"
+    assert req.failed_attempts == 0      # no retry budget burned
+    assert not other.inbox               # never re-dispatched
+    assert router.health_state(primary.replica_id) == HEALTHY
+    assert router.metrics.failures.value(reason="rejected") == 1
+
+
+@pytest.mark.fleet
+def test_thread_replica_poison_request_fails_explicitly():
+    """An engine.submit rejection (prompt too long for max_len) must
+    surface as an explicit failed completion, not kill the serve loop."""
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving.engine import ServingEngine
+    from dlrover_tpu.serving.fleet import ThreadReplica
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+
+    def factory():
+        return ServingEngine(cfg, params, slots=2, max_len=16,
+                             prefill_chunk=8)
+
+    router = FleetRouter(
+        [ThreadReplica("0", factory)],
+        RouterConfig(),
+        registry=MetricsRegistry(),
+    )
+    router.start()
+    try:
+        poison = router.submit(list(range(64)), 4)   # > max_len
+        good = router.submit([1, 2, 3], 3)
+        done = router.run_until_idle(timeout_s=60.0)
+        assert {r.request_id for r in done} == {
+            poison.request_id, good.request_id,
+        }
+        assert not poison.result.ok
+        assert poison.result.failure_reason == "rejected"
+        assert good.result.ok and len(good.result.tokens) == 3
+        assert router.health_state("0") == HEALTHY
+    finally:
+        router.stop()
+
+
+@pytest.mark.fleet
+def test_router_bounds_terminal_request_retention():
+    """A long-lived router must not retain every request ever served:
+    terminal requests are evicted FIFO past max_done_retained."""
+    router, (a,), clock = _router(n=1, max_done_retained=4)
+    for i in range(8):
+        router.submit([1, 2], 4, request_id=f"r{i}")
+        router.step()
+        a.complete(a.take())
+        router.step()
+    assert len(router.results()) == 4
+    assert set(router.results()) == {"r4", "r5", "r6", "r7"}
+    assert router.pending() == 0
+
+
+@pytest.mark.fleet
+def test_router_dispatch_fault_retries_elsewhere():
+    from dlrover_tpu.fault import FaultRule, FaultSchedule, arm, disarm
+
+    router, (a, b), clock = _router()
+    arm(FaultSchedule(
+        [FaultRule("fleet.router.dispatch", action="raise", nth=1)],
+        seed=0,
+    ))
+    try:
+        req = router.submit([1, 2], 4)
+        router.step()                    # first dispatch raises
+        clock.advance(0.2)
+        router.step()                    # retried on the other replica
+    finally:
+        disarm()
+    # The faulted dispatch marked its target tried: the retry MUST land
+    # on the other replica (least-loaded ties break on rid, so without
+    # that the same replica would be picked deterministically).
+    assert not a.inbox
+    item = b.take()
+    b.complete(item)
+    router.step()
+    assert req.result.ok
+    assert req.result.retries == 1
+
+
+@pytest.mark.fleet
+def test_router_reclaimed_completion_is_stale_not_duplicate():
+    """A completion for an attempt the router already reclaimed, landing
+    while the request is still live elsewhere, is dropped as STALE —
+    the duplicate counter stays honest for the soak's zero-duplicates
+    accounting."""
+    router, (a, b), clock = _router()
+    req = router.submit([1, 2], 4)
+    router.step()
+    item = a.take()                      # attempt 0 in flight on a
+    a.kill()
+    router.step()                        # reclaim + re-route to b
+    assert router.metrics.reroutes.value() == 1
+    a.complete(item)                     # zombie answer for attempt 0
+    router.step()
+    assert req.result is None            # still live on b
+    assert router.metrics.stale_completions.value() == 1
+    assert router.metrics.duplicates.value() == 0
+    b.complete(b.take())
+    router.step()
+    assert req.result.ok
+    assert router.metrics.requests.value(outcome="completed") == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellites: scheduler deadlines, requeue-budget reason, stub timeouts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_scheduler_deadline_sheds_queued_only():
+    from dlrover_tpu.serving.scheduler import DONE, QUEUED, Scheduler
+
+    sch = Scheduler(slots=1, max_len=32, prefill_chunk=8)
+    with_ttl = sch.submit([1, 2], 4, now=10.0, deadline_s=1.0)
+    no_ttl = sch.submit([3, 4], 4, now=10.0)
+    shed = sch.shed_expired(now=11.5)
+    assert [r.rid for r in shed] == [with_ttl.rid]
+    assert with_ttl.state == DONE and with_ttl.failed
+    assert with_ttl.failure_reason == "deadline"
+    assert with_ttl.finish_ts == 11.5
+    assert no_ttl.state == QUEUED
+    assert list(sch.queue) == [no_ttl]
+    assert sch.shed_expired(now=99.0) == []   # no-TTL never sheds
+    with pytest.raises(ValueError):
+        sch.submit([1], 2, deadline_s=0.0)
+
+
+@pytest.mark.fleet
+def test_scheduler_inflight_requests_not_shed():
+    from dlrover_tpu.serving.scheduler import Scheduler
+
+    sch = Scheduler(slots=1, max_len=32, prefill_chunk=8)
+    req = sch.submit([1, 2], 4, now=10.0, deadline_s=1.0)
+    sch.admit()                          # bound to a slot: KV is sunk
+    assert sch.shed_expired(now=99.0) == []
+    assert not req.failed
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_engine_deadline_shed_counts_and_surfaces():
+    """An expired queued request is shed by engine.step() — surfaced
+    through the step's return with the reason counter bumped — while
+    fresh work completes normally."""
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving.engine import ServingEngine
+    from dlrover_tpu.serving.scheduler import DONE
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(cfg, params, slots=1, max_len=32,
+                        prefill_chunk=8)
+    eng.warmup()
+    # Serving metrics live on the process-global registry: assert deltas.
+    shed0 = eng.metrics.shed.value(reason="deadline")
+    fail0 = eng.metrics.failures.value(reason="deadline")
+    req0 = eng.metrics.requests.value(outcome="shed")
+    doomed = eng.submit([1, 2, 3], 3, deadline_s=1e-6)
+    live = eng.submit([4, 5, 6], 3)
+    time.sleep(0.01)                     # let the TTL lapse
+    done = eng.run_until_idle(max_iters=100)
+    assert {r.rid for r in done} == {doomed.rid, live.rid}
+    assert doomed.failed and doomed.failure_reason == "deadline"
+    assert doomed.state == DONE and not doomed.tokens
+    assert live.tokens and not live.failed
+    assert eng.metrics.shed.value(reason="deadline") - shed0 == 1
+    assert eng.metrics.failures.value(reason="deadline") - fail0 == 1
+    assert eng.metrics.requests.value(outcome="shed") - req0 == 1
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_engine_requeue_budget_reason_surfaces():
+    """Requests that exhaust the step-error requeue budget carry the
+    machine-readable reason and are counted per-reason."""
+    import jax
+
+    from dlrover_tpu.fault import FaultRule, FaultSchedule, arm, disarm
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving.engine import ServingEngine
+
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(cfg, params, slots=1, max_len=32,
+                        prefill_chunk=8, max_requeues=1)
+    eng.warmup()
+    # Serving metrics live on the process-global registry: assert deltas.
+    fail0 = eng.metrics.failures.value(reason="requeue_budget")
+    req = eng.submit([1, 2, 3], 3)
+    arm(FaultSchedule(
+        [FaultRule("serving.step.error", nth=1, once=False, every=1)],
+        seed=0,
+    ))
+    try:
+        eng.run_until_idle(max_iters=50)
+    finally:
+        disarm()
+    assert req.failed
+    assert req.failure_reason == "requeue_budget"
+    assert eng.metrics.failures.value(reason="requeue_budget") == fail0 + 1
+
+
+@pytest.mark.fleet
+def test_http_stub_env_timeouts(monkeypatch):
+    """A master that accepts connections and never answers surfaces as
+    a bounded socket.timeout, not a stuck thread."""
+    from dlrover_tpu.rpc import transport
+    from dlrover_tpu.rpc.transport import HttpMasterStub
+
+    silent = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(4)
+    port = silent.getsockname()[1]
+    held = []
+    stopping = threading.Event()
+
+    def accept_and_hold():
+        while not stopping.is_set():
+            try:
+                silent.settimeout(0.1)
+                conn, _ = silent.accept()
+                held.append(conn)        # accept, never reply
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+    t = threading.Thread(target=accept_and_hold, daemon=True)
+    t.start()
+    monkeypatch.setenv(transport.READ_TIMEOUT_ENV, "0.2")
+    monkeypatch.setenv(transport.CONNECT_TIMEOUT_ENV, "1.0")
+    try:
+        stub = HttpMasterStub(f"localhost:{port}", timeout=30.0)
+        assert stub._read_timeout == 0.2         # noqa: SLF001
+        assert stub._connect_timeout == 1.0      # noqa: SLF001
+        from dlrover_tpu.common.comm import Message
+
+        t0 = time.monotonic()
+        with pytest.raises(socket.timeout):
+            stub.get(Message())
+        assert time.monotonic() - t0 < 5.0       # bounded, not stuck
+        stub.close()
+    finally:
+        stopping.set()
+        t.join(timeout=2)
+        for c in held:
+            c.close()
+        silent.close()
+
+
+@pytest.mark.fleet
+def test_http_stub_env_timeouts_ignore_garbage(monkeypatch):
+    from dlrover_tpu.rpc import transport
+    from dlrover_tpu.rpc.transport import HttpMasterStub
+
+    monkeypatch.setenv(transport.READ_TIMEOUT_ENV, "banana")
+    monkeypatch.setenv(transport.CONNECT_TIMEOUT_ENV, "-3")
+    stub = HttpMasterStub("localhost:1", timeout=7.0)
+    assert stub._read_timeout is None            # noqa: SLF001
+    assert stub._connect_timeout is None         # noqa: SLF001
+
+
+# ---------------------------------------------------------------------------
+# Slow lane: the real subprocess fleet under the seeded chaos episode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.soak
+@pytest.mark.slow
+def test_fleet_replica_kill_reroute_episode(tmp_path):
+    """Chaos soak episode 4 end-to-end: subprocess replica SIGKILLed
+    mid-decode, at-most-once completion, breaker walks back to
+    HEALTHY. Same (seed, episode) contract as tools/chaos_soak.py."""
+    from dlrover_tpu.testing.fleet_soak import (
+        FleetSoakConfig,
+        run_fleet_episode,
+    )
+    from dlrover_tpu.testing.soak import build_episode_plan
+
+    plan = build_episode_plan(0, 4)
+    assert plan.kind == "replica_kill_reroute"
+    report = run_fleet_episode(
+        0, episode=4,
+        cfg=FleetSoakConfig(watchdog_s=150.0),
+        work_dir=str(tmp_path),
+        runner_schedule=plan.runner_schedule,
+    )
+    assert report["completed"] + report["failed"] == report["requests"]
+    assert report["restarts"] >= 1
+    assert any(
+        f["point"] == "fleet.replica.step" and f["action"] == "crash"
+        for f in report["faults"]
+    )

@@ -1,0 +1,229 @@
+"""Fleet micro-bench: aggregate throughput and tail latency of the
+health-gated replica router, healthy and with a replica killed mid-run.
+
+Drives the REAL fleet (``dlrover_tpu/serving/fleet``) — a
+:class:`FleetRouter` over N subprocess serving replicas (each its own
+process and engine) — through the same seeded Poisson arrival schedule
+three ways. Each replica sleeps ``--step-delay-ms`` per engine
+iteration, simulating the accelerator's service time (the soak-worker
+``--step-ms`` idiom): the sleeps overlap across replicas the way real
+accelerators do, so what's measured is the ROUTER plane — dispatch,
+completion handling, hedging, re-routing — not the tiny model's CPU
+decode, which on a small dev host saturates the machine with one
+replica and would hide any fleet signal. The three runs:
+
+1. ``replicas=1``: the single-engine PR-4 baseline, behind the router
+   (router overhead is IN the baseline, so the N-replica deltas isolate
+   fleet scale, not dispatch cost).
+2. ``replicas=N`` healthy: aggregate tokens/s must increase over 1.
+3. ``replicas=N`` with one replica SIGKILLed a third of the way in:
+   the router reclaims the victim's in-flight ledger, re-routes, and
+   restarts it after the breaker cooldown. Every accepted request must
+   still complete or fail explicitly (completed fraction reported);
+   TTFT p99 must stay bounded, not collapse to the watchdog.
+
+Wired into ``bench.py`` as the ``fleet`` phase; also runs standalone:
+
+    python tools/bench_fleet.py --replicas 2 --requests 24
+
+Prints one JSON line. Scoreboard: ``speedup_vs_single`` (aggregate
+decoded tokens/s, N replicas over 1), ``ttft_p99_s`` (healthy fleet),
+``kill_ttft_p99_s`` and ``kill_completed_frac`` (the degraded run).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.observability.registry import MetricsRegistry  # noqa: E402
+from dlrover_tpu.serving.fleet import (  # noqa: E402
+    FleetRouter,
+    HealthPolicy,
+    RouterConfig,
+    SubprocessReplica,
+)
+
+
+def make_workload(n_requests: int, seed: int):
+    """[(arrival_s, prompt, max_new)] — Poisson arrivals, mixed prompt
+    lengths, bimodal output lengths (75% short, 25% long). The arrival
+    rate deliberately SATURATES one replica (the whole stream lands
+    within a fraction of one replica's service time): tokens/s is then
+    compute-bound and the replica-count scaling is what's measured, not
+    the arrival schedule."""
+    rs = np.random.RandomState(seed)
+    arrivals = np.cumsum(rs.exponential(scale=0.002, size=n_requests))
+    work = []
+    for i in range(n_requests):
+        prompt = rs.randint(1, 100, size=int(rs.randint(4, 13))).tolist()
+        max_new = (
+            int(rs.randint(24, 49)) if rs.rand() < 0.25
+            else int(rs.randint(8, 17))
+        )
+        work.append((float(arrivals[i]), prompt, max_new))
+    return work
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+
+def drive_fleet(
+    n_replicas: int,
+    workload,
+    work_dir: str,
+    kill_replica: Optional[str] = None,
+    kill_after_frac: float = 0.33,
+    step_delay_ms: float = 2.0,
+    timeout_s: float = 300.0,
+) -> Dict[str, float]:
+    """One fleet run over the arrival schedule (wall-clock real time);
+    optionally SIGKILL ``kill_replica`` once ``kill_after_frac`` of the
+    stream has been submitted."""
+    # step_delay_ms simulates the accelerator's per-iteration service
+    # time (the soak-worker --step-ms idiom): it sleeps, releasing the
+    # host CPU, so replica count scales aggregate throughput the way a
+    # real one-accelerator-per-replica fleet does even on a small CPU
+    # host — what's measured is the ROUTER plane (dispatch, completion
+    # handling, re-routing), which is exactly this bench's subject.
+    replicas = [
+        SubprocessReplica(
+            str(i), os.path.join(work_dir, f"n{n_replicas}"),
+            slots=2, max_len=96, prefill_chunk=16, heartbeat_s=0.1,
+            step_delay_ms=step_delay_ms,
+        )
+        for i in range(n_replicas)
+    ]
+    router = FleetRouter(
+        replicas,
+        RouterConfig(
+            max_retries=3,
+            health=HealthPolicy(
+                heartbeat_timeout_s=1.0, probe_cooldown_s=0.5
+            ),
+        ),
+        registry=MetricsRegistry(),
+    )
+    kill_at = max(1, int(len(workload) * kill_after_frac))
+    killed = False
+    submitted = []
+    try:
+        router.start(timeout_s=timeout_s)
+        t0 = time.monotonic()
+        pending = list(workload)
+        while pending or router.pending():
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"fleet bench run did not drain in {timeout_s}s"
+                )
+            now = time.monotonic() - t0
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.pop(0)
+                submitted.append(router.submit(prompt, max_new))
+            if (
+                kill_replica is not None and not killed
+                and len(submitted) >= kill_at
+            ):
+                router._replicas[kill_replica].kill()  # noqa: SLF001
+                killed = True
+            if not router.step():
+                time.sleep(0.002)
+        wall = time.monotonic() - t0
+    finally:
+        router.stop()
+    results = [r.result for r in submitted if r.result is not None]
+    lost = [r.request_id for r in submitted if r.result is None]
+    assert not lost, f"fleet bench lost requests silently: {lost}"
+    completed = [r for r in results if r.ok]
+    decoded = sum(len(r.tokens) for r in completed)
+    ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
+    reg = router.metrics
+    return {
+        "wall_s": wall,
+        "requests_done": len(results),
+        "completed": len(completed),
+        "failed": len(results) - len(completed),
+        "completed_frac": len(completed) / max(len(results), 1),
+        "decoded_tokens": decoded,
+        "tokens_per_s": decoded / max(wall, 1e-9),
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p99_s": _percentile(ttfts, 99),
+        "retries": reg.retries.value(),
+        "reroutes": reg.reroutes.value(),
+        "restarts": reg.restarts.value(),
+    }
+
+
+def run_bench(
+    replicas: int = 2,
+    n_requests: int = 32,
+    seed: int = 0,
+    step_delay_ms: float = 2.0,
+    timeout_s: float = 300.0,
+) -> Dict[str, float]:
+    workload = make_workload(n_requests, seed)
+    out: Dict[str, float] = {
+        "replicas": replicas,
+        "requests": n_requests,
+        "step_delay_ms": step_delay_ms,
+    }
+    with tempfile.TemporaryDirectory(prefix="dlrover_bfleet_") as wd:
+        single = drive_fleet(
+            1, workload, os.path.join(wd, "single"),
+            step_delay_ms=step_delay_ms, timeout_s=timeout_s,
+        )
+        fleet = drive_fleet(
+            replicas, workload, os.path.join(wd, "fleet"),
+            step_delay_ms=step_delay_ms, timeout_s=timeout_s,
+        )
+        kill = drive_fleet(
+            replicas, workload, os.path.join(wd, "kill"),
+            kill_replica="0", step_delay_ms=step_delay_ms,
+            timeout_s=timeout_s,
+        )
+    out.update({
+        "single_tokens_per_s": round(single["tokens_per_s"], 1),
+        "single_ttft_p99_s": round(single["ttft_p99_s"], 4),
+        "tokens_per_s": round(fleet["tokens_per_s"], 1),
+        "ttft_p50_s": round(fleet["ttft_p50_s"], 4),
+        "ttft_p99_s": round(fleet["ttft_p99_s"], 4),
+        "speedup_vs_single": round(
+            fleet["tokens_per_s"] / max(single["tokens_per_s"], 1e-9), 2
+        ),
+        "kill_tokens_per_s": round(kill["tokens_per_s"], 1),
+        "kill_ttft_p99_s": round(kill["ttft_p99_s"], 4),
+        "kill_completed_frac": round(kill["completed_frac"], 4),
+        "kill_reroutes": int(kill["reroutes"]),
+        "kill_retries": int(kill["retries"]),
+        "kill_restarts": int(kill["restarts"]),
+    })
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--step-delay-ms", type=float, default=2.0)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ns = ap.parse_args(argv)
+    out = run_bench(
+        replicas=ns.replicas, n_requests=ns.requests, seed=ns.seed,
+        step_delay_ms=ns.step_delay_ms, timeout_s=ns.timeout_s,
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,10 @@
 """Seeded chaos-soak CLI: drive the whole stack through reproducible
 fault episodes and assert the five system invariants.
 
-    python tools/chaos_soak.py --seed 0 --episodes 4
+    python tools/chaos_soak.py --seed 0 --episodes 5
     python tools/chaos_soak.py --seed 0 --episode 1      # repro one
     python tools/chaos_soak.py --seed 0 --episode 3      # rescale kill
+    python tools/chaos_soak.py --seed 0 --episode 4      # fleet reroute
 
 Each episode runs an in-process master, worker subprocesses and a
 serving engine under a deterministic seeded fault schedule (worker
@@ -12,9 +13,14 @@ serving step errors, SIGKILL mid-live-rescale ...). Episode 3 is the
 multi-worker ``kill_during_rescale`` episode
 (``dlrover_tpu/testing/rescale_soak.py``): a worker is killed between
 the rescale-plan ack and the first post-rescale step, and the restored
-state must still be bit-identical to the single-host reference. The
+state must still be bit-identical to the single-host reference.
+Episode 4 is the serving-fleet ``replica_kill_reroute`` episode
+(``dlrover_tpu/testing/fleet_soak.py``): a router over N subprocess
+serving replicas has one replica SIGKILLed mid-decode; every accepted
+request must complete or be explicitly failed exactly once and the
+victim's breaker must walk BROKEN → HALF_OPEN → HEALTHY. The
 implementation and the invariant definitions live in
-``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26/§27); exit code 0
+``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26-§28); exit code 0
 means every episode held every invariant. Prints one JSON summary line
 with goodput fraction and per-fault MTTR — the same numbers
 ``bench.py``'s ``chaos_goodput`` phase reports.
@@ -38,9 +44,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="seeded chaos soak")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--episodes", type=int, default=4,
-        help="episode count; 4 covers the full fault matrix incl. "
-        "kill_during_rescale",
+        "--episodes", type=int, default=5,
+        help="episode count; 5 covers the full fault matrix incl. "
+        "kill_during_rescale and replica_kill_reroute",
     )
     parser.add_argument(
         "--episode", type=int, default=None,
