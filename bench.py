@@ -1545,6 +1545,8 @@ _KEEP_KEYS = {
     "fleet_tokens_per_s", "fleet_speedup_vs_single",
     "fleet_ttft_p99_s", "fleet_kill_ttft_p99_s",
     "fleet_kill_completed_frac",
+    "serving_tracing_overhead_pct",
+    "phase_seconds", "peak_rss_mb",
     "prev_round_diff",
 }
 
@@ -1618,6 +1620,18 @@ def emit(result: dict):
     round-over-round diff is refreshed on every line, not just the
     final one)."""
     result["elapsed_s"] = round(time.time() - _T0, 1)
+    try:
+        import resource
+
+        # Linux ru_maxrss is KiB; peak host RSS of the bench process —
+        # a phase that balloons memory shows up here even when it
+        # otherwise succeeds.
+        result["peak_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            1,
+        )
+    except Exception:  # pragma: no cover - non-POSIX fallback
+        pass
     result["prev_round_diff"] = prev_round_diff(result)
     line = json.dumps(_prune(result))
     print(line, flush=True)
@@ -1659,6 +1673,7 @@ def run_phase(result, name, fn, est_s, cap_s=None):
     except (TypeError, ValueError):
         takes_sink = False
     sink = {}
+    t_phase = time.time()
     old = signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(cap)
     try:
@@ -1687,6 +1702,12 @@ def run_phase(result, name, fn, est_s, cap_s=None):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+        # Bench self-observability: every phase stamps its wall seconds
+        # (even on error/timeout — that IS the interesting case), so a
+        # budget-starved round shows WHERE the budget went.
+        result.setdefault("phase_seconds", {})[name] = round(
+            time.time() - t_phase, 1
+        )
     emit(result)
 
 

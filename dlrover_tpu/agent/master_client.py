@@ -15,6 +15,7 @@ from dlrover_tpu.common.comm import Message
 from dlrover_tpu.common.constants import JobConstant, NodeEnv
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.rpc.transport import build_master_stub
 
 
@@ -28,6 +29,12 @@ def retry_rpc(func):
     NOT wrapped. The ±30% jitter keeps a fleet of workers whose RPCs all
     failed together (master restart) from re-synchronizing into retry
     stampedes.
+
+    Tracing: ONE client span covers every attempt — a retried RPC is
+    the same logical operation re-sent, so the span's ``retry`` attr
+    increments instead of minting sibling spans, and the server spans
+    of all attempts parent to it (the at-most-once story stays visible
+    as one wire operation).
     """
 
     def wrapper(self, *args, **kwargs):
@@ -35,14 +42,21 @@ def retry_rpc(func):
             kwargs.pop("retry", JobConstant.MASTER_CLIENT_DEFAULT_RETRY), 1
         )
         err = None
-        for i in range(retry):
-            if i > 0:
-                backoff = min(2 ** (i - 1), 8)
-                time.sleep(backoff * (1.0 + random.uniform(-0.3, 0.3)))
-            try:
-                return func(self, *args, **kwargs)
-            except Exception as e:  # noqa: BLE001 — transport errors vary
-                err = e
+        with tracing.span(f"rpc.{func.__name__}", kind="client") as sp:
+            for i in range(retry):
+                if i > 0:
+                    sp.inc_attr("retry")
+                    backoff = min(2 ** (i - 1), 8)
+                    time.sleep(backoff * (1.0 + random.uniform(-0.3, 0.3)))
+                try:
+                    return func(self, *args, **kwargs)
+                except Exception as e:  # noqa: BLE001 — transports vary
+                    err = e
+            sp.set_attr("error", type(err).__name__)
+            # The raise happens OUTSIDE the with block, so __exit__
+            # would close this span "ok" — end it as the failure it is
+            # (end() is idempotent; __exit__'s end becomes a no-op).
+            sp.end(status="error")
         logger.warning("RPC %s failed after %d tries: %s", func.__name__, retry, err)
         raise err
 
@@ -74,6 +88,9 @@ class MasterClient:
             node_id=self._node_id,
             node_type=self._node_type,
             data=request.serialize(),
+            # Active span's context (the retry_rpc span, or any caller
+            # span) rides the envelope; None when tracing is disarmed.
+            trace=tracing.current_carrier(),
         )
         resp = self._stub.get(msg, timeout=timeout)
         return comm.BaseResponse.deserialize(resp.data)
@@ -84,6 +101,7 @@ class MasterClient:
             node_id=self._node_id,
             node_type=self._node_type,
             data=request.serialize(),
+            trace=tracing.current_carrier(),
         )
         resp = self._stub.report(msg, timeout=timeout)
         return comm.BaseResponse.deserialize(resp.data)
@@ -308,7 +326,12 @@ class MasterClient:
         except Exception:
             logger.debug("resource report failed", exc_info=True)
 
-    def report_global_step(self, step: int, elapsed_train_secs: float = 0.0):
+    def report_global_step(
+        self,
+        step: int,
+        elapsed_train_secs: float = 0.0,
+        step_time_s: float = 0.0,
+    ):
         try:
             return self._report(
                 comm.GlobalStepReport(
@@ -316,10 +339,27 @@ class MasterClient:
                     step=step,
                     timestamp=time.time(),
                     elapsed_train_secs=elapsed_train_secs,
+                    step_time_s=step_time_s,
                 )
             )
         except Exception:
             logger.debug("global step report failed", exc_info=True)
+
+    def report_trace_spans(self, max_n: int = 256):
+        """Push this process's finished spans to the master's trace
+        aggregator, piggybacked on the existing diagnosis-data verb.
+        Best-effort and disarmed-free: one tracer check, nothing else."""
+        tracer = tracing.active_tracer()
+        if tracer is None:
+            return
+        spans = tracer.drain_exports(max_n)
+        if not spans:
+            return
+        from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+
+        self.report_diagnosis_data(
+            DiagnosisDataType.TRACE_SPANS, {"spans": spans}
+        )
 
     def report_goodput_phase(self, phase: str, start: float, end: float):
         try:

@@ -528,6 +528,25 @@ class ElasticAgent:
                 )
             except Exception:  # noqa: BLE001
                 logger.debug("flight record report failed", exc_info=True)
+        # Hang-watchdog / SIGUSR1 stack dumps ride the same postmortem
+        # path: a wedged-then-killed worker's blocked frames reach the
+        # master's hang diagnostician as evidence.
+        try:
+            from dlrover_tpu.observability.hang_watchdog import (
+                collect_hang_dumps,
+            )
+
+            started = getattr(self, "_workers_started_at", 0.0)
+            max_age = max(time.time() - started, 0.0) if started else None
+            hang_dumps = collect_hang_dumps(
+                self._spec.node_rank, codes.keys(), max_age_s=max_age
+            )
+            for local_rank, dump in hang_dumps.items():
+                self._client.report_diagnosis_data(
+                    DiagnosisDataType.STACK_DUMP, dump
+                )
+        except Exception:  # noqa: BLE001 — postmortem best-effort
+            logger.debug("hang dump report failed", exc_info=True)
 
     def _on_workers_failed(self) -> Optional[RunResult]:
         codes = self._failed_exit_codes()

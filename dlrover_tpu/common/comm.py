@@ -23,6 +23,12 @@ class Message(PickleSerializable):
     node_id: int = -1
     node_type: str = ""
     data: bytes = b""
+    # Distributed-trace context ({"trace_id", "span_id"}) stamped by the
+    # client when tracing is armed, so the servicer's server span joins
+    # the caller's tree (docs/DESIGN.md §29). None when disarmed — and
+    # readers use getattr(): envelopes pickled by older builds carry no
+    # attribute at all.
+    trace: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -224,6 +230,10 @@ class GlobalStepReport(BaseRequest):
     step: int = 0
     timestamp: float = 0.0
     elapsed_train_secs: float = 0.0  # productive train time since last report
+    # This rank's recent per-step wall seconds (0 = not measured): the
+    # master's straggler score is per-rank step-time skew, and this
+    # piggyback keeps it one existing RPC, not a new verb.
+    step_time_s: float = 0.0
 
 
 @dataclass

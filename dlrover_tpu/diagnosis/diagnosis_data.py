@@ -18,6 +18,12 @@ class DiagnosisDataType:
     RESOURCE = "resource"
     XPU_TIMER_METRIC = "xpu_timer_metric"
     FLIGHT_RECORDER = "flight_recorder"
+    # All-thread sys._current_frames() captures from the worker-side
+    # hang watchdog / SIGUSR1 on-demand dump (observability §29).
+    STACK_DUMP = "stack_dump"
+    # Finished distributed-trace spans pushed by workers, routed to the
+    # master's TraceAggregator behind /api/traces.
+    TRACE_SPANS = "trace_spans"
 
 
 @dataclass
@@ -72,6 +78,30 @@ class FlightRecord(DiagnosisData):
     steps: List[Dict] = field(default_factory=list)
 
 
+@dataclass
+class StackDump(DiagnosisData):
+    """All-thread sys._current_frames() capture from a worker's hang
+    watchdog / SIGUSR1 dump, relayed by the agent — the evidence the
+    hang diagnostician folds into its escalation."""
+
+    data_type: str = DiagnosisDataType.STACK_DUMP
+    reason: str = ""
+    meta: Dict = field(default_factory=dict)
+    stacks: Dict[str, List[str]] = field(default_factory=dict)
+    hang_for_s: float = 0.0
+
+
+@dataclass
+class TraceSpans(DiagnosisData):
+    """A batch of finished distributed-trace spans pushed by a worker
+    (the /api/traces feed; the servicer ALSO routes these straight to
+    its TraceAggregator — this record keeps the generic per-node
+    diagnosis ring consistent)."""
+
+    data_type: str = DiagnosisDataType.TRACE_SPANS
+    spans: List[Dict] = field(default_factory=list)
+
+
 def build_diagnosis_data(data_type, node_id, payload, timestamp=0.0):
     """Reconstruct a DiagnosisData from the generic RPC report
     (comm.DiagnosisDataReport: data_type + free-form payload dict)."""
@@ -81,6 +111,8 @@ def build_diagnosis_data(data_type, node_id, payload, timestamp=0.0):
         DiagnosisDataType.RESOURCE: NodeResourceData,
         DiagnosisDataType.XPU_TIMER_METRIC: XpuTimerMetric,
         DiagnosisDataType.FLIGHT_RECORDER: FlightRecord,
+        DiagnosisDataType.STACK_DUMP: StackDump,
+        DiagnosisDataType.TRACE_SPANS: TraceSpans,
     }
     cls = classes.get(data_type)
     if cls is None:

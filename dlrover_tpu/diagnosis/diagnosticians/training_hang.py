@@ -34,12 +34,18 @@ class TrainingHangDiagnostician(Diagnostician):
         restart_after_s: float = 1800.0,
         metric_context=None,
         clock=time.time,
+        stack_dump_provider=None,
     ):
         self._perf_monitor = perf_monitor
         self._job_manager = job_manager
         self._hang_timeout_s = hang_timeout_s
         self._restart_after_s = restart_after_s
         self._hang_since = 0.0
+        # Callable returning recent worker stack dumps (the
+        # hang_watchdog's sys._current_frames() captures, reported as
+        # "stack_dump" diagnosis data): lets the escalation name the
+        # blocked frame instead of just "no step progress".
+        self._stack_dump_provider = stack_dump_provider
         # Injectable clock: escalation thresholds are minutes-scale in
         # production, and the tests must drive stagnation -> EventAction
         # -> JobRestartAction without real sleeps.
@@ -100,21 +106,54 @@ class TrainingHangDiagnostician(Diagnostician):
         self._hang_since = 0.0
         return Observation()
 
+    def _stack_evidence(self) -> str:
+        """Blocked-frame summary from worker stack dumps, '' when none:
+        "rank 3 blocked in psum_wait (foo.py:42)". The provider is the
+        worker-side hang watchdog's capture, relayed over the diagnosis
+        verb — evidence, not a trigger, so failures stay silent."""
+        if self._stack_dump_provider is None:
+            return ""
+        try:
+            dumps = self._stack_dump_provider() or []
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            return ""
+        parts = []
+        for dump in dumps[:4]:
+            if not isinstance(dump, dict):
+                continue
+            meta = dump.get("meta", {})
+            rank = meta.get("node_rank", dump.get("node_rank", "?"))
+            stacks = dump.get("stacks", {})
+            # The innermost frame of the main thread (or any thread
+            # when unnamed) is where the worker actually sits.
+            frames = (
+                stacks.get(next(
+                    (k for k in stacks if k.startswith("MainThread")),
+                    "",
+                )) or next(iter(stacks.values()), [])
+            )
+            if frames:
+                top = frames[-1]
+                parts.append(f"rank {rank} blocked in {top}")
+        return "; ".join(parts)
+
     def resolve(self, ob: Observation, **kwargs) -> DiagnosisAction:
         hang_for = self._clock() - self._hang_since
+        evidence = self._stack_evidence()
+        suffix = f" ({evidence})" if evidence else ""
         if hang_for >= self._restart_after_s:
             self._hang_since = 0.0
             return JobRestartAction(
                 reason=(
                     f"no step progress for {hang_for:.0f}s at step "
-                    f"{ob.extra.get('step')}"
+                    f"{ob.extra.get('step')}{suffix}"
                 )
             )
         return EventAction(
             event_type="warning",
             event_msg=(
                 f"training hang suspected: step {ob.extra.get('step')} "
-                f"stalled for {ob.extra.get('hang_for_s')}s"
+                f"stalled for {ob.extra.get('hang_for_s')}s{suffix}"
             ),
             reason=_HANG_OBSERVATION,
         )

@@ -159,12 +159,14 @@ class DashboardServer:
         rdzv_managers=None,
         task_manager=None,
         metric_context=None,
+        trace_aggregator=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
         self._rdzv_managers = rdzv_managers or {}
         self._task_manager = task_manager
         self._metric_context = metric_context
+        self._trace_aggregator = trace_aggregator
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self.port = 0
@@ -223,6 +225,20 @@ class DashboardServer:
                         json.dumps(dashboard._phases()),
                         "application/json",
                     )
+                elif self.path == "/api/stragglers":
+                    # Live per-rank step-time skew (the autoscaler's and
+                    # SRE's "which rank is slow RIGHT NOW" view).
+                    self._send(
+                        200,
+                        json.dumps(dashboard._stragglers()),
+                        "application/json",
+                    )
+                elif self.path.startswith("/api/traces"):
+                    self._send(
+                        200,
+                        json.dumps(dashboard._traces(self.path)),
+                        "application/json",
+                    )
                 elif self.path.startswith("/api/node/"):
                     detail = dashboard._node_detail(
                         self.path.rsplit("/", 1)[-1]
@@ -273,6 +289,23 @@ class DashboardServer:
         if callable(records):
             return records()
         return {"init_time": 0.0, "max_phase_end": 0.0, "records": []}
+
+    def _stragglers(self):
+        report = getattr(self._perf_monitor, "straggler_report", None)
+        if callable(report):
+            return report()
+        return {"ranks": {}, "stragglers": [], "median_step_time_s": 0.0}
+
+    def _traces(self, path: str):
+        """``/api/traces`` -> recent trace summaries;
+        ``/api/traces/<trace_id>`` -> that trace's nested span tree."""
+        agg = self._trace_aggregator
+        if agg is None:
+            return {"traces": [], "enabled": False}
+        tail = path[len("/api/traces"):].strip("/")
+        if tail:
+            return {"trace_id": tail, "tree": agg.tree(tail)}
+        return {"traces": agg.recent(), "enabled": True}
 
     def _metrics_text(self):
         from dlrover_tpu.observability.prom import master_metrics_text

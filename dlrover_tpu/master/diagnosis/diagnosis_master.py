@@ -100,3 +100,18 @@ class DiagnosisMaster:
     def node_data(self, node_id: int) -> List[DiagnosisData]:
         with self._lock:
             return list(self._node_data.get(node_id, ()))
+
+    def recent_data(self, data_type: str, limit: int = 8) -> List[Dict]:
+        """Newest-first reports of one type across all nodes, as plain
+        dicts — the hang diagnostician's stack_dump_provider reads the
+        relayed worker stack captures through this."""
+        out: List[Dict] = []
+        with self._lock:
+            for node_id, ring in self._node_data.items():
+                for data in ring:
+                    if data.data_type == data_type:
+                        record = dict(vars(data))
+                        record["node_id"] = node_id
+                        out.append(record)
+        out.sort(key=lambda r: r.get("timestamp", 0.0), reverse=True)
+        return out[:limit]

@@ -163,6 +163,20 @@ class DistributedJobMaster:
                 ),
                 local_world_size=devices_per_node,
             )
+        # Recent trace trees (workers push span summaries over the
+        # diagnosis-data verb; /api/traces serves them).
+        from dlrover_tpu.observability import tracing as tracing_lib
+
+        self.trace_aggregator = tracing_lib.TraceAggregator()
+        # Master-side spans (servicer server spans) reach /api/traces
+        # too when the master traces — armed explicitly or via the
+        # DLROVER_TPU_TRACE_FILE env rigging.
+        _tracer = (
+            tracing_lib.active_tracer()
+            or tracing_lib.arm_from_env(service="master")
+        )
+        if _tracer is not None:
+            _tracer.set_on_finish(self.trace_aggregator.ingest_one)
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
@@ -170,6 +184,7 @@ class DistributedJobMaster:
             diagnosis_master=diagnosis_master,
             perf_monitor=self.perf_monitor,
             rescale_coordinator=self.rescale_coordinator,
+            trace_aggregator=self.trace_aggregator,
         )
         self._server = create_master_server(port, self.servicer, transport)
         self.port = self._server.port
@@ -218,6 +233,7 @@ class DistributedJobMaster:
                     if self.metric_monitor is not None
                     else None
                 ),
+                trace_aggregator=self.trace_aggregator,
             )
         self.auto_scaler = None
         if auto_scale:
@@ -264,19 +280,6 @@ class DistributedJobMaster:
         )
 
         manager = DiagnosisManager()
-        manager.register(
-            TrainingHangDiagnostician(
-                self.perf_monitor,
-                self.job_manager,
-                metric_context=(
-                    self.metric_monitor.context
-                    if self.metric_monitor is not None
-                    else None
-                ),
-            )
-        )
-        manager.register(NodeFailureDiagnostician())
-        manager.register(NodeInconsistencyDiagnostician())
         operators = []
         if pre_check:
             operators = [
@@ -286,9 +289,30 @@ class DistributedJobMaster:
                     lambda: self.servicer.node_last_contact()
                 ),
             ]
-        return DiagnosisMaster(
+        dm = DiagnosisMaster(
             pre_check_operators=operators, manager=manager
         )
+        from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+
+        manager.register(
+            TrainingHangDiagnostician(
+                self.perf_monitor,
+                self.job_manager,
+                metric_context=(
+                    self.metric_monitor.context
+                    if self.metric_monitor is not None
+                    else None
+                ),
+                # Late-bound: workers' relayed stack dumps let the hang
+                # escalation name the blocked frame.
+                stack_dump_provider=lambda: dm.recent_data(
+                    DiagnosisDataType.STACK_DUMP
+                ),
+            )
+        )
+        manager.register(NodeFailureDiagnostician())
+        manager.register(NodeInconsistencyDiagnostician())
+        return dm
 
     @classmethod
     def from_args(cls, args) -> "DistributedJobMaster":

@@ -62,6 +62,16 @@ class LocalJobMaster:
                 batch_config,
                 local_world_size=devices_per_node,
             )
+        from dlrover_tpu.observability import tracing as tracing_lib
+
+        self.trace_aggregator = tracing_lib.TraceAggregator()
+        _tracer = (
+            tracing_lib.active_tracer()
+            or tracing_lib.arm_from_env(service="master")
+        )
+        if _tracer is not None:
+            # Master's own server spans feed /api/traces directly.
+            _tracer.set_on_finish(self.trace_aggregator.ingest_one)
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
@@ -69,6 +79,7 @@ class LocalJobMaster:
             diagnosis_master=self.diagnosis_master,
             perf_monitor=self.perf_monitor,
             rescale_coordinator=self.rescale_coordinator,
+            trace_aggregator=self.trace_aggregator,
         )
         self._server = create_master_server(port, self.servicer, transport)
         self.port = self._server.port
@@ -89,9 +100,19 @@ class LocalJobMaster:
         )
 
         manager = DiagnosisManager()
-        manager.register(TrainingHangDiagnostician(self.perf_monitor))
+        dm = DiagnosisMaster(manager=manager)
+        from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+
+        manager.register(TrainingHangDiagnostician(
+            self.perf_monitor,
+            # Late-bound: workers' relayed stack dumps let the hang
+            # escalation name the blocked frame.
+            stack_dump_provider=lambda: dm.recent_data(
+                DiagnosisDataType.STACK_DUMP
+            ),
+        ))
         manager.register(NodeFailureDiagnostician())
-        return DiagnosisMaster(manager=manager)
+        return dm
 
     def prepare(self):
         for mgr in self.rdzv_managers.values():

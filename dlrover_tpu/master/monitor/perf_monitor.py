@@ -37,6 +37,12 @@ class PerfMonitor:
         self._phase_records_dropped = 0
         self._max_phase_end = 0.0
         self._init_time = time.time()
+        # Per-rank step-time EWMAs -> the straggler score (§29): skew of
+        # one rank's step wall time against the fleet median. Fed by the
+        # step_time_s piggyback on GlobalStepReport.
+        self._rank_step_ewma: Dict[int, float] = {}
+        self._rank_step_reports: Dict[int, int] = {}
+        self._last_gauge_refresh = 0.0
         registry = default_registry()
         self._phase_secs_counter = registry.counter(
             "dlrover_goodput_phase_seconds_total",
@@ -47,11 +53,21 @@ class PerfMonitor:
             "dlrover_step_reports_total",
             "global-step reports received by the master",
         )
+        self._straggler_gauge = registry.gauge(
+            "dlrover_straggler_score",
+            "per-rank step-time skew vs the fleet median (1.0 = median)",
+            labelnames=("rank",),
+        )
 
     # ---- step speed --------------------------------------------------------
 
     def collect_global_step(
-        self, step: int, timestamp: float, elapsed_train_secs: float = 0.0
+        self,
+        step: int,
+        timestamp: float,
+        elapsed_train_secs: float = 0.0,
+        node_id: int = -1,
+        step_time_s: float = 0.0,
     ):
         with self._lock:
             if self._last_step_report is not None:
@@ -64,7 +80,93 @@ class PerfMonitor:
             self._global_step = max(self._global_step, step)
             if elapsed_train_secs > 0:
                 self._total_train_secs += elapsed_train_secs
+            if node_id >= 0 and step_time_s > 0:
+                prev = self._rank_step_ewma.get(node_id)
+                self._rank_step_ewma[node_id] = (
+                    step_time_s if prev is None
+                    else 0.3 * step_time_s + 0.7 * prev
+                )
+                self._rank_step_reports[node_id] = (
+                    self._rank_step_reports.get(node_id, 0) + 1
+                )
         self._step_reports_counter.inc()
+        if node_id >= 0 and step_time_s > 0:
+            self._update_straggler_gauges()
+
+    # ---- straggler score ---------------------------------------------------
+
+    STRAGGLER_THRESHOLD = 1.5
+    STRAGGLER_MIN_REPORTS = 3
+
+    def straggler_report(
+        self,
+        threshold: Optional[float] = None,
+        min_reports: Optional[int] = None,
+    ) -> Dict:
+        """Per-rank step-time skew: ``score = rank EWMA / fleet
+        median``; a rank is flagged once its score clears ``threshold``
+        over at least ``min_reports`` reports (one slow step must not
+        page anyone). Live view behind ``/api/stragglers`` and the
+        ``dlrover_straggler_score`` gauge."""
+        threshold = (
+            threshold if threshold is not None else self.STRAGGLER_THRESHOLD
+        )
+        min_reports = (
+            min_reports if min_reports is not None
+            else self.STRAGGLER_MIN_REPORTS
+        )
+        with self._lock:
+            ewmas = dict(self._rank_step_ewma)
+            reports = dict(self._rank_step_reports)
+        if not ewmas:
+            return {
+                "ranks": {}, "stragglers": [],
+                "median_step_time_s": 0.0, "threshold": threshold,
+            }
+        ordered = sorted(ewmas.values())
+        mid = len(ordered) // 2
+        median = (
+            ordered[mid] if len(ordered) % 2
+            else 0.5 * (ordered[mid - 1] + ordered[mid])
+        )
+        ranks = {}
+        stragglers = []
+        for rank, ewma in sorted(ewmas.items()):
+            score = ewma / max(median, 1e-9)
+            flagged = (
+                len(ewmas) >= 2
+                and score >= threshold
+                and reports.get(rank, 0) >= min_reports
+            )
+            ranks[rank] = {
+                "step_time_ewma_s": round(ewma, 6),
+                "score": round(score, 4),
+                "reports": reports.get(rank, 0),
+                "flagged": flagged,
+            }
+            if flagged:
+                stragglers.append(rank)
+        return {
+            "ranks": ranks,
+            "stragglers": stragglers,
+            "median_step_time_s": round(median, 6),
+            "threshold": threshold,
+        }
+
+    # Full-report recompute is O(R log R); refreshing it on EVERY rank's
+    # report would make the RPC handler O(R^2 log R) per cadence at
+    # fleet scale. One refresh per window keeps the gauge live without
+    # taxing the handler; /api/stragglers always computes fresh.
+    GAUGE_REFRESH_S = 1.0
+
+    def _update_straggler_gauges(self):
+        now = time.time()
+        with self._lock:
+            if now - self._last_gauge_refresh < self.GAUGE_REFRESH_S:
+                return
+            self._last_gauge_refresh = now
+        for rank, info in self.straggler_report()["ranks"].items():
+            self._straggler_gauge.set(info["score"], rank=str(rank))
 
     @property
     def global_step(self) -> int:
@@ -155,3 +257,5 @@ class PerfMonitor:
             self._phase_records_dropped = 0
             self._init_time = time.time()
             self._max_phase_end = 0.0
+            self._rank_step_ewma.clear()
+            self._rank_step_reports.clear()

@@ -26,6 +26,7 @@ from dlrover_tpu.master.elastic_training.rdzv_manager import (
 )
 from dlrover_tpu.master.elastic_training.sync_service import SyncService
 from dlrover_tpu.fault import fault_point
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.rpc.transport import MasterService
 
 
@@ -42,8 +43,14 @@ class MasterServicer(MasterService):
         job_metric_collector=None,
         elastic_ps_service: Optional[ClusterVersionService] = None,
         rescale_coordinator=None,
+        trace_aggregator=None,
     ):
         self._rescale_coordinator = rescale_coordinator
+        # Recent trace trees served at /api/traces: fed by workers
+        # pushing drained spans over DiagnosisDataReport and by the
+        # master's own armed tracer (the master wires its tracer's
+        # on_finish to the aggregator at construction).
+        self._trace_aggregator = trace_aggregator
         self._rdzv_managers = rdzv_managers
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -139,7 +146,15 @@ class MasterServicer(MasterService):
                 success=False, reason=f"no get handler for {type(request)}"
             )
         else:
-            response = handler(message, request)
+            # Server span parented to the caller's envelope context:
+            # the worker's client RPC span and this handler span share
+            # one trace. Disarmed: one global check, a no-op object.
+            with tracing.server_span(
+                f"master.{type(request).__name__}",
+                getattr(message, "trace", None),
+                node_id=message.node_id,
+            ):
+                response = handler(message, request)
         # AFTER the handler: any state mutation (lease moved to doing,
         # kv value read) already happened — dropping the reply here is
         # the "response lost on the wire" fault the client-side retry
@@ -162,7 +177,12 @@ class MasterServicer(MasterService):
                 success=False, reason=f"no report handler for {type(request)}"
             )
         else:
-            response = handler(message, request)
+            with tracing.server_span(
+                f"master.{type(request).__name__}",
+                getattr(message, "trace", None),
+                node_id=message.node_id,
+            ):
+                response = handler(message, request)
         # State already applied; a dropped reply makes the client re-send
         # — report handlers must stay safe to re-apply (at-most-once
         # effect), which the chaos soak asserts.
@@ -346,6 +366,16 @@ class MasterServicer(MasterService):
         return comm.BaseResponse(True)
 
     def _report_diagnosis_data(self, msg, req: comm.DiagnosisDataReport):
+        from dlrover_tpu.diagnosis.diagnosis_data import DiagnosisDataType
+
+        if (
+            req.data_type == DiagnosisDataType.TRACE_SPANS
+            and self._trace_aggregator is not None
+        ):
+            # Worker span push (piggybacked on this existing verb):
+            # feed /api/traces directly; the generic diagnosis store
+            # still records the report below.
+            self._trace_aggregator.ingest(req.payload.get("spans", ()))
         if self._diagnosis_master is not None:
             self._diagnosis_master.collect_diagnosis_data(req)
         return comm.BaseResponse(True)
@@ -360,7 +390,11 @@ class MasterServicer(MasterService):
     def _report_global_step(self, msg, req: comm.GlobalStepReport):
         if self._perf_monitor is not None:
             self._perf_monitor.collect_global_step(
-                req.step, req.timestamp, req.elapsed_train_secs
+                req.step,
+                req.timestamp,
+                req.elapsed_train_secs,
+                node_id=req.node_id,
+                step_time_s=getattr(req, "step_time_s", 0.0),
             )
         return comm.BaseResponse(True)
 

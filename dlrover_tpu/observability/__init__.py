@@ -15,6 +15,12 @@ and ONE merged timeline (``tools/merge_timeline.py``).
   N steps of a dead worker survive for diagnosis.
 - :mod:`trace_merge` — clock-offset-aligned fusion of all signal
   sources into a single chrome-trace/Perfetto JSON per job.
+- :mod:`tracing` — cross-process distributed tracing: spans with
+  trace/span/parent ids, context carried on the RPC envelopes, JSONL
+  sinks, and the master-side trace aggregator behind ``/api/traces``.
+- :mod:`hang_watchdog` — worker-side rolling-deadline hang detection
+  with all-thread ``sys._current_frames()`` stack dumps the agent
+  collects.
 """
 
 from dlrover_tpu.observability.registry import (  # noqa: F401

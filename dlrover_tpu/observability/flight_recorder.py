@@ -146,18 +146,30 @@ class FlightRecorder:
             "steps": steps,
         }
 
-    def dump(self, path: Optional[str] = None) -> Optional[str]:
+    def dump(self, path: Optional[str] = None,
+             with_stacks: bool = False) -> Optional[str]:
         """Atomic JSON dump (tmp + rename: the agent may read while the
         worker is dying). Returns the path, or None on failure — the
-        dump runs on crash paths and must never raise."""
+        dump runs on crash paths and must never raise.
+
+        ``with_stacks`` adds every thread's current frames (the
+        on-demand SIGUSR1 diagnostics payload)."""
         path = path or self._dump_target
         if not path:
             return None
         try:
+            snapshot = self.snapshot()
+            if with_stacks:
+                from dlrover_tpu.observability.hang_watchdog import (
+                    dump_all_stacks,
+                )
+
+                snapshot["stacks"] = dump_all_stacks()
+                snapshot["on_demand"] = True
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
-                json.dump(self.snapshot(), f)
+                json.dump(snapshot, f)
             os.replace(tmp, path)
             return path
         except Exception:  # noqa: BLE001 - crash path
@@ -193,6 +205,40 @@ class FlightRecorder:
 
         sys.excepthook = hook
         atexit.register(self.dump)
+
+    def on_demand_path(self) -> Optional[str]:
+        """Sibling of the crash-dump path: the exit/crash dump must not
+        clobber an operator's on-demand capture (atexit re-dumps the
+        ring on every clean exit)."""
+        if not self._dump_target:
+            return None
+        base, ext = os.path.splitext(self._dump_target)
+        return f"{base}.ondemand{ext or '.json'}"
+
+    def install_on_demand_dump(self, signum: Optional[int] = None):
+        """SIGUSR1 = live diagnostics: dump the ring PLUS all-thread
+        stacks to an agent-collectable sibling path and KEEP RUNNING —
+        an operator (or the agent, suspecting a wedge) can interrogate
+        a worker without killing it. Previous crash/SIGTERM behavior
+        is untouched; the handler never re-raises the signal."""
+        if signum is None:
+            signum = getattr(signal, "SIGUSR1", None)
+            if signum is None:  # platform without SIGUSR1
+                return
+
+        def handler(sig, frame):
+            # No logging in the handler: the signal may have interrupted
+            # a frame holding the logging module's non-reentrant handler
+            # lock — logger.info here would deadlock the very process
+            # this dump is meant to leave running. The dump path is a
+            # pure function of (node_rank, local_rank); operators know
+            # where to look.
+            self.dump(path=self.on_demand_path(), with_stacks=True)
+
+        try:
+            signal.signal(signum, handler)
+        except (ValueError, OSError):  # non-main thread / weird env
+            pass
 
     def _make_handler(self, signum):
         def handler(sig, frame):
@@ -236,6 +282,7 @@ def install_recorder(
         full_meta.update(meta or {})
         rec = FlightRecorder(capacity=capacity, meta=full_meta)
         rec.install_crash_dump(dump_path(node_rank, local_rank))
+        rec.install_on_demand_dump()
         _recorder = rec
         logger.info(
             "flight recorder armed -> %s",

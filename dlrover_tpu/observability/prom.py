@@ -12,9 +12,15 @@ tpu_timer daemons — one scrape covers the whole job.
 from typing import Dict, List, Optional
 
 from dlrover_tpu.observability.registry import (
+    Histogram,
     MetricsRegistry,
     default_registry,
 )
+
+# Precomputed per-histogram quantile gauges: (suffix, q). Consumers
+# (dashboard panels, the autoscaler's latency checks) read a gauge
+# instead of re-deriving quantiles from cumulative buckets client-side.
+_QUANTILE_GAUGES = (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
 
 
 def _escape_label(value: str) -> str:
@@ -45,7 +51,36 @@ def render_registry(registry: Optional[MetricsRegistry] = None) -> str:
         lines.append(f"# TYPE {family.name} {family.kind}")
         for name, labels, value in family.samples():
             lines.append(_format_sample(name, labels, value))
+        if isinstance(family, Histogram):
+            lines.extend(_quantile_lines(family))
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _quantile_lines(family: Histogram) -> List[str]:
+    """``<name>_p50/_p95/_p99`` gauges per labelled child, computed at
+    scrape time from the cumulative buckets."""
+    children = sorted(
+        {
+            tuple(sorted(labels.items()))
+            for name, labels, _v in family.samples()
+            if name == f"{family.name}_count"
+        }
+    )
+    lines: List[str] = []
+    for suffix, q in _QUANTILE_GAUGES:
+        emitted_type = False
+        for child in children:
+            labels = dict(child)
+            value = family.quantile(q, **labels)
+            if value is None:
+                continue
+            if not emitted_type:
+                lines.append(f"# TYPE {family.name}_{suffix} gauge")
+                emitted_type = True
+            lines.append(
+                _format_sample(f"{family.name}_{suffix}", labels, value)
+            )
+    return lines
 
 
 def render_perf(perf_monitor) -> str:

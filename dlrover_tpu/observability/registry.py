@@ -136,6 +136,37 @@ class Histogram(_Family):
             state = self._hist.get(key)
             return state[len(self.buckets)] if state else 0.0
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimated q-quantile (0..1) from the cumulative buckets —
+        Prometheus ``histogram_quantile`` semantics (linear
+        interpolation inside the target bucket), precomputed server-
+        side so scrapers need no quantile math. None with no samples;
+        observations beyond the last finite bucket clamp to it."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1]")
+        key = self._key(labels)
+        with self._lock:
+            state = self._hist.get(key)
+            if state is None:
+                return None
+            counts = list(state[: len(self.buckets)])
+            total = state[len(self.buckets)]
+        if total <= 0:
+            return None
+        target = q * total
+        prev_bound = 0.0
+        prev_count = 0.0
+        for bound, cum in zip(self.buckets, counts):
+            if cum >= target:
+                in_bucket = cum - prev_count
+                if in_bucket <= 0:
+                    return bound
+                frac = (target - prev_count) / in_bucket
+                return prev_bound + frac * (bound - prev_bound)
+            prev_bound = bound
+            prev_count = cum
+        return self.buckets[-1] if self.buckets else None
+
     def sum(self, **labels) -> float:
         key = self._key(labels)
         with self._lock:

@@ -305,7 +305,16 @@ class HttpMasterStub:
 
     def _call(self, path: str, message: Message, timeout=None) -> Message:
         body = message.serialize()
-        for _ in (1, 2):
+        for attempt in (1, 2):
+            if attempt > 1:
+                # The transparent stale-keep-alive re-send below is the
+                # SAME logical RPC: the active span (opened by the
+                # client's retry wrapper or any caller) records it as
+                # an incremented retry attr, never a sibling span — so
+                # the at-most-once story stays legible in one trace.
+                from dlrover_tpu.observability import tracing
+
+                tracing.bump_current("retry")
             conn, reused = self._connection(timeout)
             try:
                 conn.request("POST", path, body=body)

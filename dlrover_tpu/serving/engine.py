@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
 from dlrover_tpu.models import generate as gen_lib
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.models import llama
 from dlrover_tpu.serving import scheduler as sched_lib
 from dlrover_tpu.serving.metrics import serving_metrics
@@ -261,10 +262,15 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               trace: Optional[dict] = None) -> Request:
         req = self.scheduler.submit(
             prompt, max_new_tokens, temperature, deadline_s=deadline_s
         )
+        # Upstream trace carrier (fleet attempt span): stored as a
+        # plain dict; the phase spans are emitted retrospectively at
+        # completion, so the step loop never touches the tracer.
+        req.trace = trace
         self.metrics.queue_depth.set(len(self.scheduler.queue))
         return req
 
@@ -325,6 +331,7 @@ class ServingEngine:
             self.metrics.annotate(
                 "serving_shed", rid=req.rid, reason="deadline"
             )
+            self._emit_request_spans(req, status="error")
         for req in sch.admit():
             # A recycled slot starts from fill 0: stale KV above the
             # cursor is invisible and rewritten before visibility.
@@ -403,6 +410,7 @@ class ServingEngine:
                 req.failure_reason = "requeue_budget"
                 self.scheduler.finish(req)
                 finished.append(req)
+                self._emit_request_spans(req, status="error")
                 failed += 1
                 self.metrics.requests.inc(outcome="failed")
                 self.metrics.failures.inc(reason="requeue_budget")
@@ -485,6 +493,60 @@ class ServingEngine:
         self.metrics.annotate(
             "serving_finish", rid=req.rid, slot=slot,
             new_tokens=len(req.tokens), truncated=req.truncated,
+        )
+        self._emit_request_spans(req)
+
+    def _emit_request_spans(self, req: Request, status: str = "ok"):
+        """Retrospective phase tree for one terminal request: queue-wait
+        / prefill / decode cut at the timestamps the scheduler already
+        records, contiguous by construction so their durations sum to
+        the request's e2e latency (the §29 trace invariant). Disarmed:
+        one global check — zero per-iteration cost in the step loop."""
+        tracer = tracing.active_tracer()
+        if tracer is None:
+            return
+        finish = (
+            req.finish_ts if req.finish_ts is not None
+            else time.monotonic()
+        )
+        root = tracer.record_span(
+            "serving.request", req.submit_ts, finish,
+            kind="server", parent=req.trace,
+            attrs={
+                "rid": req.rid,
+                "prompt_len": req.prompt_len,
+                "new_tokens": len(req.tokens),
+                "truncated": req.truncated,
+                "requeues": req.requeues,
+                "failure_reason": req.failure_reason,
+            },
+            status=status,
+        )
+        if req.admit_ts is None:
+            # Never reached a slot (shed / failed while queued): the
+            # whole life was queue wait.
+            tracer.record_span(
+                "serving.queue_wait", req.submit_ts, finish,
+                parent=root, status=status,
+            )
+            return
+        tracer.record_span(
+            "serving.queue_wait", req.submit_ts, req.admit_ts,
+            parent=root,
+        )
+        if req.first_token_ts is None:
+            tracer.record_span(
+                "serving.prefill", req.admit_ts, finish,
+                parent=root, status=status,
+            )
+            return
+        tracer.record_span(
+            "serving.prefill", req.admit_ts, req.first_token_ts,
+            parent=root, attrs={"prompt_len": req.prompt_len},
+        )
+        tracer.record_span(
+            "serving.decode", req.first_token_ts, finish,
+            parent=root, attrs={"new_tokens": len(req.tokens)},
         )
 
     def _sync_retrace_metric(self):

@@ -64,6 +64,10 @@ class WorkItem:
     max_new_tokens: int
     temperature: float = 0.0
     deadline_s: Optional[float] = None
+    # Trace carrier of the router's attempt span ({"trace_id",
+    # "span_id"} or None): the replica engine parents its phase spans
+    # to it, so a rerouted request is one tree across processes.
+    trace: Optional[dict] = None
 
     def to_wire(self) -> dict:
         return {
@@ -74,6 +78,7 @@ class WorkItem:
             "max_new_tokens": self.max_new_tokens,
             "temperature": self.temperature,
             "deadline_s": self.deadline_s,
+            "trace": self.trace,
         }
 
 
@@ -99,7 +104,8 @@ def _completion(item_key, ok, tokens, truncated, failure_reason,
 
 
 def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
-                 max_new_tokens, temperature, deadline_s) -> None:
+                 max_new_tokens, temperature, deadline_s,
+                 trace=None) -> None:
     """One work item into the engine — shared by both replica modes so
     the wire behavior cannot drift. A scheduler rejection (prompt too
     long, bad deadline) is an EXPLICIT failed completion, never a crash:
@@ -108,6 +114,7 @@ def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
         req = engine.submit(
             prompt, max_new_tokens,
             temperature=temperature, deadline_s=deadline_s,
+            trace=trace,
         )
     except Exception:  # noqa: BLE001 — any rejection is the same event
         emit(_completion(
@@ -283,7 +290,7 @@ class ThreadReplica:
                     engine, by_rid, emit,
                     item.request_id, item.attempt, item.prompt,
                     item.max_new_tokens, item.temperature,
-                    item.deadline_s,
+                    item.deadline_s, trace=item.trace,
                 )
                 moved = True
             if engine.pending():
@@ -356,6 +363,18 @@ class SubprocessReplica:
                 f"trace_replica{self.replica_id}.jsonl",
             ),
         })
+        from dlrover_tpu.observability import tracing as tracing_lib
+
+        if tracing_lib.active_tracer() is not None:
+            # Parent traces -> children trace too, each into its own
+            # JSONL (a SIGKILLed replica's finished spans survive; the
+            # soak merges the files). Disarmed parents rig nothing.
+            env[tracing_lib.TRACE_FILE_ENV] = os.path.join(
+                self._work_dir,
+                f"spans_replica{self.replica_id}.jsonl",
+            )
+        else:
+            env.pop(tracing_lib.TRACE_FILE_ENV, None)
         sched = self._schedule_path
         if not isinstance(sched, str):
             sched = (

@@ -64,8 +64,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from dlrover_tpu.fault import arm_from_env, fault_point
+    from dlrover_tpu.observability import tracing
 
     arm_from_env()
+    # Same env-rigging pattern for tracing: DLROVER_TPU_TRACE_FILE set
+    # by the parent replica handle when the router process traces.
+    tracing.arm_from_env(service=f"replica{args.replica_id}")
 
     import jax
 
@@ -119,6 +123,7 @@ def main(argv=None) -> int:
                     cmd["request_id"], cmd.get("attempt", 0),
                     cmd["prompt"], cmd["max_new_tokens"],
                     cmd.get("temperature", 0.0), cmd.get("deadline_s"),
+                    trace=cmd.get("trace"),
                 )
         if engine.pending():
             # The chaos episode's SIGKILL-mid-decode lands here: a

@@ -54,6 +54,7 @@ import numpy as np
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.serving.fleet import health as health_lib
 from dlrover_tpu.serving.fleet.metrics import fleet_metrics
 from dlrover_tpu.serving.fleet.replica import ReplicaDeadError, WorkItem
@@ -124,6 +125,12 @@ class FleetRequest:
     )
     tried_replicas: set = field(default_factory=set)
     result: Optional[FleetResult] = None
+    # Tracing (None when disarmed): one root span per request, one
+    # child span per dispatch attempt — retries and hedges are SIBLING
+    # spans under the root, so a rerouted request's tree shows the
+    # failed attempt next to the one that won.
+    span: Optional[object] = None
+    attempt_spans: Dict[int, object] = field(default_factory=dict)
 
     @property
     def done(self) -> bool:
@@ -250,6 +257,16 @@ class FleetRouter:
             return req
         self.metrics.requests.inc(outcome="accepted")
         self._live_accepted += 1
+        tracer = tracing.active_tracer()
+        if tracer is not None:
+            req.span = tracer.start_span(
+                "fleet.request", kind="server",
+                attrs={
+                    "request_id": request_id,
+                    "max_new_tokens": req.max_new_tokens,
+                    "prompt_len": len(prompt),
+                },
+            )
         self._queue.append(req)
         self.metrics.queue_depth.set(
             len(self._queue) + len(self._waiting)
@@ -348,6 +365,7 @@ class FleetRouter:
             self.metrics.duplicates.inc()
             return
         live = req.live_attempts.pop(attempt, None)
+        aspan = req.attempt_spans.pop(attempt, None)
         if live is not None and live[2]:
             self._health[rid].end_probe()
         if entry is None and live is None:
@@ -358,6 +376,15 @@ class FleetRouter:
             self.metrics.stale_completions.inc()
             return
         dispatch_t = live[1] if live is not None else req.submit_t
+        if aspan is not None:
+            if not event.get("ok"):
+                aspan.set_attr(
+                    "failure_reason",
+                    event.get("failure_reason") or "replica_error",
+                )
+            aspan.end(
+                status="ok" if event.get("ok") else "error"
+            )
         if event.get("ok"):
             self._service_lat.append(max(0.0, now - dispatch_t))
             self._health[rid].record_success()
@@ -410,6 +437,21 @@ class FleetRouter:
             if is_probe:
                 self._health[rid].end_probe()
         req.live_attempts.clear()
+        for aspan in req.attempt_spans.values():
+            aspan.set_attr("abandoned", True)
+            aspan.end(status="error")
+        req.attempt_spans.clear()
+        if req.span is not None:
+            req.span.set_attr("retries", result.retries)
+            req.span.set_attr("hedged", result.hedged)
+            if result.replica_id:
+                req.span.set_attr("replica", result.replica_id)
+            if not result.ok:
+                req.span.set_attr(
+                    "failure_reason", result.failure_reason
+                )
+            req.span.end(status="ok" if result.ok else "error")
+            req.span = None
         newly_done.append(req)
         self._retain_done(req.request_id)
 
@@ -539,6 +581,12 @@ class FleetRouter:
             live = req.live_attempts.pop(attempt, None)
             if live is not None and live[2]:
                 self._health[rid].end_probe()
+            aspan = req.attempt_spans.pop(attempt, None)
+            if aspan is not None:
+                # The failed attempt stays in the trace as an error
+                # sibling of whatever retry eventually wins.
+                aspan.set_attr("failure_reason", "replica_death")
+                aspan.end(status="error")
             victims.append(req)
             self.metrics.reroutes.inc()
         # Reversed submit order + appendleft = oldest ends up first;
@@ -686,6 +734,14 @@ class FleetRouter:
         deadline_s = None
         if req.deadline is not None:
             deadline_s = max(0.001, req.deadline - now)
+        aspan = None
+        tracer = tracing.active_tracer()
+        if tracer is not None and req.span is not None:
+            aspan = tracer.start_span(
+                "fleet.attempt", kind="client", parent=req.span,
+                attrs={"replica": rid, "kind": kind,
+                       "attempt": attempt},
+            )
         item = WorkItem(
             request_id=req.request_id,
             attempt=attempt,
@@ -693,6 +749,7 @@ class FleetRouter:
             max_new_tokens=req.max_new_tokens,
             temperature=req.temperature,
             deadline_s=deadline_s,
+            trace=aspan.carrier() if aspan is not None else None,
         )
         try:
             fault_point(
@@ -702,6 +759,9 @@ class FleetRouter:
             self._replicas[rid].submit(item)
         except Exception as e:  # noqa: BLE001 — ReplicaDeadError,
             # injected dispatch faults, broken pipes: all one path.
+            if aspan is not None:
+                aspan.set_attr("failure_reason", "dispatch_error")
+                aspan.end(status="error")
             h.record_failure(f"dispatch:{type(e).__name__}")
             # The replica was tried and failed us — without this the
             # retry's least-loaded sort can deterministically pick the
@@ -718,6 +778,8 @@ class FleetRouter:
             return False
         req.attempt_seq += 1
         req.tried_replicas.add(rid)
+        if aspan is not None:
+            req.attempt_spans[attempt] = aspan
         if req.first_dispatch_t is None:
             req.first_dispatch_t = now
             self.metrics.queue_wait.observe(now - req.submit_t)

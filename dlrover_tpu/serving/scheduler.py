@@ -60,6 +60,15 @@ class Request:
     deadline: Optional[float] = None
     first_token_ts: Optional[float] = None
     finish_ts: Optional[float] = None
+    # Slot-admission time (monotonic): queue-wait = admit_ts -
+    # submit_ts. The engine's retrospective phase spans (§29) are cut
+    # at submit/admit/first-token/finish — plain floats recorded here,
+    # zero tracing work inside the loop.
+    admit_ts: Optional[float] = None
+    # Upstream trace carrier ({"trace_id","span_id"} from the fleet
+    # router's attempt span, or None): the emitted phase spans parent
+    # to it so one request is one tree across processes.
+    trace: Optional[dict] = None
 
     @property
     def prompt_len(self) -> int:
@@ -161,7 +170,7 @@ class Scheduler:
             self.queue = kept
         return shed
 
-    def admit(self) -> List[Request]:
+    def admit(self, now: Optional[float] = None) -> List[Request]:
         """Bind queued requests to free slots (FCFS). Under drain_mode,
         only when EVERY slot is free — the drain-and-refill baseline."""
         if self.drain_mode and len(self._free) < self.slots:
@@ -171,6 +180,7 @@ class Scheduler:
             req = self.queue.popleft()
             req.slot = self._free.popleft()
             req.state = PREFILL
+            req.admit_ts = now if now is not None else time.monotonic()
             self.by_slot[req.slot] = req
             admitted.append(req)
         return admitted
@@ -237,6 +247,7 @@ class Scheduler:
             req.tokens = []
             req.truncated = False
             req.first_token_ts = None
+            req.admit_ts = None
             req.requeues += 1
             self.queue.appendleft(req)
         return victims

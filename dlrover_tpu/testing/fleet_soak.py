@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import FaultRule, FaultSchedule, arm, disarm
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.serving.fleet import (
     BROKEN,
     HALF_OPEN,
@@ -109,34 +110,53 @@ def run_fleet_episode(
     from dlrover_tpu.observability.registry import MetricsRegistry
 
     registry = MetricsRegistry()
-    replicas = [
-        SubprocessReplica(
-            str(i), ep_dir,
-            slots=cfg.slots, max_len=cfg.max_len,
-            prefill_chunk=cfg.prefill_chunk,
-            # Per-generation: the victim's SIGKILL schedule arms only
-            # generation 0 — its post-restart generations run clean, so
-            # the half-open probes can actually succeed.
-            schedule_path=(
-                [schedule_paths[str(i)]]
-                if str(i) in schedule_paths else ""
+    # Tracing is part of the episode's proof surface (§29): the router
+    # traces into its own sink, each replica subprocess into its own
+    # (rigged through the env by SubprocessReplica.start), and the
+    # trace invariant below reads the merged files.
+    prev_tracer = tracing.active_tracer()
+    router_sink = os.path.join(ep_dir, "spans_router.jsonl")
+    tracing.arm(tracing.Tracer(service="router", sink_path=router_sink))
+
+    def _restore_tracer():
+        tracing.disarm()
+        if prev_tracer is not None:
+            tracing.arm(prev_tracer)
+
+    try:
+        replicas = [
+            SubprocessReplica(
+                str(i), ep_dir,
+                slots=cfg.slots, max_len=cfg.max_len,
+                prefill_chunk=cfg.prefill_chunk,
+                # Per-generation: the victim's SIGKILL schedule arms
+                # only generation 0 — its post-restart generations run
+                # clean, so the half-open probes can actually succeed.
+                schedule_path=(
+                    [schedule_paths[str(i)]]
+                    if str(i) in schedule_paths else ""
+                ),
+            )
+            for i in range(cfg.replicas)
+        ]
+        router = FleetRouter(
+            replicas,
+            RouterConfig(
+                max_retries=3,
+                seed=ep_seed,
+                health=HealthPolicy(
+                    heartbeat_timeout_s=2.0,
+                    probe_cooldown_s=0.5,
+                    probe_successes=2,
+                ),
             ),
+            registry=registry,
         )
-        for i in range(cfg.replicas)
-    ]
-    router = FleetRouter(
-        replicas,
-        RouterConfig(
-            max_retries=3,
-            seed=ep_seed,
-            health=HealthPolicy(
-                heartbeat_timeout_s=2.0,
-                probe_cooldown_s=0.5,
-                probe_successes=2,
-            ),
-        ),
-        registry=registry,
-    )
+    except BaseException:
+        # Construction failed before the run's own finally could take
+        # over: the episode tracer must not stay armed process-wide.
+        _restore_tracer()
+        raise
     if runner_schedule is not None:
         arm(runner_schedule)
 
@@ -200,6 +220,7 @@ def run_fleet_episode(
         if runner_schedule is not None:
             disarm()
         router.stop()
+        _restore_tracer()
 
     wall = time.time() - t_start
     report: Dict = {
@@ -210,11 +231,23 @@ def run_fleet_episode(
         "victim": victim,
         "requests": len(accepted),
     }
+    import glob as glob_lib
+
+    episode_spans = tracing.load_spans(
+        [router_sink]
+        + sorted(glob_lib.glob(os.path.join(ep_dir, "spans_replica*.jsonl")))
+    )
     try:
         if failure:
             raise SoakInvariantError(failure)
         _check_fleet_invariant(
             accepted, router, registry, victim, health_seen
+        )
+        trace_stats = _check_trace_invariant(
+            episode_spans,
+            require_reroute=registry.get(
+                "fleet_reroutes_total"
+            ).value() >= 1,
         )
     except SoakInvariantError as e:
         dest = _dump_artifacts(
@@ -253,6 +286,9 @@ def run_fleet_episode(
         "recovery_s": [],
         "steps_unique": len(completed),
         "steps_executed": len(results),
+        "trace_spans": len(episode_spans),
+        "trace_rerouted_trees": trace_stats["rerouted_trees"],
+        "trace_phase_sum_checked": trace_stats["phase_sum_checked"],
         "faults": [
             t
             for rid in schedules
@@ -265,6 +301,57 @@ def run_fleet_episode(
     if not cfg.keep_artifacts_on_success:
         shutil.rmtree(ep_dir, ignore_errors=True)
     return report
+
+
+def _check_trace_invariant(spans, require_reroute: bool) -> Dict:
+    """The §29 trace proof: (a) a rerouted request's tree shows the
+    failed attempt and the retry as SIBLING spans under one
+    fleet.request root; (b) queue-wait + prefill + decode child spans
+    sum to the serving.request e2e duration within 10%."""
+    rerouted = 0
+    for tree in tracing.build_trees(spans):
+        if tree.get("name") != "fleet.request":
+            continue
+        attempts = [
+            c for c in tree["children"] if c.get("name") == "fleet.attempt"
+        ]
+        failed = [a for a in attempts if a.get("status") == "error"]
+        won = [a for a in attempts if a.get("status") == "ok"]
+        if len(attempts) >= 2 and failed and won:
+            rerouted += 1
+    if require_reroute and rerouted == 0:
+        raise SoakInvariantError(
+            "requests were rerouted but no trace tree shows a failed "
+            "attempt and a retry as sibling spans"
+        )
+    checked = 0
+    for record in spans:
+        if record.get("name") != "serving.request":
+            continue
+        if record.get("status") != "ok" or not record.get("dur_s"):
+            continue
+        children = [
+            s for s in spans
+            if s.get("parent_id") == record.get("span_id")
+            and s.get("dur_s") is not None
+        ]
+        if len(children) < 3:
+            continue  # shed/failed partial trees don't carry all phases
+        phase_sum = sum(s["dur_s"] for s in children)
+        e2e = record["dur_s"]
+        if abs(phase_sum - e2e) > max(0.1 * e2e, 0.005):
+            raise SoakInvariantError(
+                f"trace {record.get('trace_id')}: queue-wait + prefill "
+                f"+ decode sum {phase_sum:.4f}s vs e2e {e2e:.4f}s — "
+                f"phases no longer partition the request"
+            )
+        checked += 1
+    if checked == 0:
+        raise SoakInvariantError(
+            "no completed serving.request span carried its full "
+            "queue-wait/prefill/decode phase tree"
+        )
+    return {"rerouted_trees": rerouted, "phase_sum_checked": checked}
 
 
 def _check_fleet_invariant(accepted, router, registry, victim,
@@ -338,6 +425,8 @@ def _dump_artifacts(ep_dir, artifact_dir, schedules, seed, episode,
     for src in glob.glob(os.path.join(ep_dir, "replica*_gen*.log")):
         shutil.copy(src, dest)
     for src in glob.glob(os.path.join(ep_dir, "trace_replica*.jsonl")):
+        shutil.copy(src, dest)
+    for src in glob.glob(os.path.join(ep_dir, "spans_*.jsonl")):
         shutil.copy(src, dest)
     for rid, sched in schedules.items():
         with open(

@@ -15,6 +15,7 @@ from typing import Callable, List
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
+from dlrover_tpu.observability import tracing
 
 
 @dataclass
@@ -125,6 +126,7 @@ class ElasticTrainer:
         steps: int = 1,
         data_wait_s: float = 0.0,
         ckpt_block_s: float = 0.0,
+        allreduce_wait_s: float = 0.0,
     ):
         self.global_step += steps
         # Chaos site: "mid-step" from the job's perspective — the step
@@ -133,17 +135,31 @@ class ElasticTrainer:
         # SIGKILL the soak's recovery invariants are proved against.
         fault_point("agent.worker.crash", step=self.global_step)
         now = time.time()
+        prev = self._last_step_ts or now
+        step_time_s = max(now - prev, 0.0) / max(steps, 1)
         if self._flight_recorder is not None:
             # Host-side bookkeeping between steps — nothing here touches
             # the jitted path. Step wall time is the gap since the last
             # completion (covers dispatch + device + data).
-            prev = self._last_step_ts or now
             self._flight_recorder.record_step(
                 self.global_step,
-                step_time_s=max(now - prev, 0.0) / max(steps, 1),
+                step_time_s=step_time_s,
                 data_wait_s=data_wait_s,
                 ckpt_block_s=ckpt_block_s,
             )
+        self._emit_step_spans(
+            step_time_s * max(steps, 1),
+            data_wait_s, allreduce_wait_s, ckpt_block_s,
+        )
+        # Progress beacon for the rolling-deadline hang watchdog (§29):
+        # one global check when none is installed.
+        from dlrover_tpu.observability.hang_watchdog import (
+            active_watchdog,
+        )
+
+        watchdog = active_watchdog()
+        if watchdog is not None:
+            watchdog.beat()
         self._last_step_ts = now
         if (
             self._client is not None
@@ -153,10 +169,60 @@ class ElasticTrainer:
             elapsed = now - self._train_started if self._train_started else 0
             try:
                 self._client.report_global_step(
-                    self.global_step, elapsed_train_secs=elapsed
+                    self.global_step,
+                    elapsed_train_secs=elapsed,
+                    # Straggler signal: the master skews this against
+                    # the other ranks' reports.
+                    step_time_s=step_time_s,
                 )
+                # Finished spans ride the same cadence (separate
+                # best-effort verb; no-op when tracing is disarmed).
+                report_spans = getattr(
+                    self._client, "report_trace_spans", None
+                )
+                if callable(report_spans):
+                    report_spans()
             except Exception:
                 logger.warning("global step report failed", exc_info=True)
+
+    def _emit_step_spans(
+        self,
+        step_wall_s: float,
+        data_wait_s: float,
+        allreduce_wait_s: float,
+        ckpt_block_s: float,
+    ):
+        """Retrospective per-step phase tree: one ``train.step`` root
+        per completed step with data-fetch / compute / allreduce-wait /
+        ckpt-persist children cut from the durations the caller already
+        measured. Phase placement inside the step is the canonical
+        order (fetch -> compute -> allreduce -> persist); the exact
+        durations ride as attrs. Disarmed: one global check."""
+        tracer = tracing.active_tracer()
+        if tracer is None:
+            return
+        end = time.monotonic()
+        start = end - max(step_wall_s, 0.0)
+        root = tracer.record_span(
+            "train.step", start, end,
+            attrs={"step": self.global_step, "dp_size": self.dp_size},
+        )
+        waits = data_wait_s + allreduce_wait_s + ckpt_block_s
+        compute_s = max(step_wall_s - waits, 0.0)
+        cursor = start
+        for name, dur in (
+            ("train.data_fetch", data_wait_s),
+            ("train.step_compute", compute_s),
+            ("train.allreduce_wait", allreduce_wait_s),
+            ("train.ckpt_persist", ckpt_block_s),
+        ):
+            if dur <= 0.0:
+                continue
+            tracer.record_span(
+                name, cursor, min(cursor + dur, end), parent=root,
+                attrs={"seconds": round(dur, 6)},
+            )
+            cursor += dur
 
     def epoch_of(self, dataset_size: int) -> int:
         consumed = self.global_step * self.batch_config.global_batch_size

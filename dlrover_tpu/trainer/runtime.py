@@ -101,8 +101,61 @@ def init_distributed(timeout_secs: int = 300) -> DistributedContext:
         )
     _maybe_start_tpu_timer(ctx)
     _setup_flight_recorder(ctx)
+    _setup_tracing(ctx)
+    _setup_hang_watchdog(ctx)
     _context = ctx
     return ctx
+
+
+def _setup_hang_watchdog(ctx: DistributedContext):
+    """Arm the rolling-deadline hang watchdog (on by default: a wedged
+    worker that stops beating past ``max(DLROVER_TPU_HANG_DEADLINE_S,
+    factor x EWMA(step gap))`` dumps all-thread stacks to the
+    agent-collectable path). ``DLROVER_TPU_HANG_DEADLINE_S=0`` disables;
+    the default 300s floor keeps slow-compile first steps quiet."""
+    try:
+        from dlrover_tpu.observability import hang_watchdog
+
+        raw = os.getenv("DLROVER_TPU_HANG_DEADLINE_S", "300")
+        try:
+            floor_s = float(raw)
+        except ValueError:
+            floor_s = 300.0
+        if floor_s <= 0:
+            return
+        node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+        hang_watchdog.install_watchdog(
+            node_rank=node_rank,
+            local_rank=ctx.local_rank,
+            min_deadline_s=floor_s,
+            meta={"process_id": ctx.process_id},
+        )
+    except Exception:
+        logger.warning("hang watchdog unavailable", exc_info=True)
+
+
+def _setup_tracing(ctx: DistributedContext):
+    """Arm distributed tracing when the env rigging asks for it
+    (``DLROVER_TPU_TRACE_FILE``, same contract the fleet replica worker
+    honors). Per-process sink: ``<path>`` gets ``.rank<pid>`` inserted
+    before the extension on multi-process worlds so workers never
+    interleave writes into one file. Disarmed (env unset) costs nothing
+    — every span site stays one global check."""
+    try:
+        from dlrover_tpu.observability import tracing
+
+        path = os.getenv(tracing.TRACE_FILE_ENV, "")
+        if not path:
+            return
+        if ctx.num_processes > 1:
+            base, ext = os.path.splitext(path)
+            path = f"{base}.rank{ctx.process_id}{ext or '.jsonl'}"
+        tracing.arm(tracing.Tracer(
+            service=f"worker{ctx.process_id}", sink_path=path
+        ))
+        logger.info("tracing armed -> %s", path)
+    except Exception:
+        logger.warning("tracing unavailable", exc_info=True)
 
 
 def _setup_flight_recorder(ctx: DistributedContext):

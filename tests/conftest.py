@@ -48,3 +48,8 @@ def pytest_configure(config):
         "fleet: self-healing serving fleet (health-gated router, "
         "retries/hedges, crash re-routing) — docs/DESIGN.md §28",
     )
+    config.addinivalue_line(
+        "markers",
+        "trace: cross-process distributed tracing + straggler/hang "
+        "diagnosis plane — docs/DESIGN.md §29",
+    )

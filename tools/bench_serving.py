@@ -111,6 +111,7 @@ def run_bench(
     max_len: int = 224,
     prefill_chunk: int = 32,
     seed: int = 0,
+    tracing_ab: bool = True,
 ) -> Dict[str, float]:
     cfg = llama.tiny_config()
     params, _ = llama.init_params(cfg, __import__("jax").random.key(0))
@@ -157,6 +158,37 @@ def run_bench(
             cont["tokens_per_s"] / max(static["tokens_per_s"], 1e-9), 2
         ),
     }
+    if tracing_ab:
+        # Armed-tracing A/B on the SAME workload and compiled steps:
+        # the §29 overhead budget says <2% tokens/s with spans flowing
+        # to a real JSONL sink (4 retrospective spans per request).
+        import tempfile
+
+        from dlrover_tpu.observability import tracing
+
+        sink = tempfile.NamedTemporaryFile(
+            suffix=".spans.jsonl", delete=False
+        )
+        sink.close()
+        prev = tracing.active_tracer()
+        tracing.arm(tracing.Tracer(service="bench", sink_path=sink.name))
+        try:
+            traced = drive(fresh(drain=False), workload)
+        finally:
+            tracing.disarm()
+            if prev is not None:
+                tracing.arm(prev)
+            try:
+                os.unlink(sink.name)
+            except OSError:
+                pass
+        out["traced_tokens_per_s"] = round(traced["tokens_per_s"], 1)
+        out["tracing_overhead_pct"] = round(
+            100.0
+            * (cont["tokens_per_s"] - traced["tokens_per_s"])
+            / max(cont["tokens_per_s"], 1e-9),
+            2,
+        )
     return out
 
 

@@ -1,0 +1,197 @@
+"""Query/summarize distributed-trace JSONL (observability §29).
+
+Operates on the span sinks written by ``dlrover_tpu.observability.
+tracing`` (``DLROVER_TPU_TRACE_FILE``, the fleet soak's
+``spans_*.jsonl``, a replica's per-process sink):
+
+    # the 10 slowest spans across files
+    python tools/trace_query.py spans_router.jsonl spans_replica0.jsonl
+
+    # per-span-name latency table (count / mean / p50 / p95 / max)
+    python tools/trace_query.py --summary spans_*.jsonl
+
+    # one trace's tree + critical path
+    python tools/trace_query.py --trace 7f3a... spans_*.jsonl
+
+Plain stdlib + the tracing module's own loaders — usable on any box
+that has the repo, no collector service required.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.observability.tracing import (  # noqa: E402
+    build_trees,
+    load_spans,
+)
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(int(q / 100.0 * len(ordered)), len(ordered) - 1)
+    return ordered[idx]
+
+
+def slowest(spans: List[Dict], top: int = 10,
+            name: Optional[str] = None) -> List[Dict]:
+    pool = [
+        s for s in spans
+        if s.get("dur_s") is not None
+        and (name is None or s.get("name") == name)
+    ]
+    pool.sort(key=lambda s: -s["dur_s"])
+    return pool[:top]
+
+
+def summarize(spans: List[Dict]) -> List[Dict]:
+    """Per-name latency table, slowest-by-p95 first."""
+    by_name: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for record in spans:
+        dur = record.get("dur_s")
+        if dur is None:
+            continue
+        name = record.get("name", "?")
+        by_name.setdefault(name, []).append(dur)
+        if record.get("status") not in ("ok", None):
+            errors[name] = errors.get(name, 0) + 1
+    rows = []
+    for name, durs in by_name.items():
+        rows.append({
+            "name": name,
+            "count": len(durs),
+            "errors": errors.get(name, 0),
+            "mean_s": sum(durs) / len(durs),
+            "p50_s": _percentile(durs, 50),
+            "p95_s": _percentile(durs, 95),
+            "max_s": max(durs),
+        })
+    rows.sort(key=lambda r: -r["p95_s"])
+    return rows
+
+
+def critical_path(spans: List[Dict], trace_id: str) -> List[Dict]:
+    """Longest-duration root-to-leaf chain of one trace: at each level,
+    descend into the slowest child. Each hop reports its duration and
+    its SELF time (duration minus its children's sum) — the hop where
+    self time dominates is where the wall-clock went."""
+    trace_spans = [s for s in spans if s.get("trace_id") == trace_id]
+    roots = build_trees(trace_spans)
+    if not roots:
+        return []
+    node = max(roots, key=lambda r: r.get("dur_s") or 0.0)
+    path = []
+    while node is not None:
+        children = node.get("children", [])
+        child_sum = sum(c.get("dur_s") or 0.0 for c in children)
+        dur = node.get("dur_s") or 0.0
+        path.append({
+            "name": node.get("name"),
+            "span_id": node.get("span_id"),
+            "service": node.get("service", ""),
+            "status": node.get("status"),
+            "dur_s": dur,
+            "self_s": max(dur - child_sum, 0.0),
+            "attrs": node.get("attrs", {}),
+        })
+        node = (
+            max(children, key=lambda c: c.get("dur_s") or 0.0)
+            if children else None
+        )
+    return path
+
+
+def render_tree(node: Dict, indent: int = 0) -> List[str]:
+    dur = node.get("dur_s")
+    dur_txt = f"{dur * 1e3:9.3f}ms" if dur is not None else "      ...  "
+    status = node.get("status", "ok")
+    mark = "" if status == "ok" else f"  [{status}]"
+    lines = [
+        f"{dur_txt}  {'  ' * indent}{node.get('name')}"
+        f" ({node.get('service', '') or '-'}){mark}"
+    ]
+    for child in node.get("children", []):
+        lines.extend(render_tree(child, indent + 1))
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="+", help="span JSONL files")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-span count (default mode)")
+    ap.add_argument("--name", help="filter spans by name")
+    ap.add_argument("--summary", action="store_true",
+                    help="per-name latency table")
+    ap.add_argument("--trace",
+                    help="print one trace's tree + critical path")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ns = ap.parse_args(argv)
+    spans = load_spans(ns.files)
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+
+    if ns.trace:
+        roots = build_trees(
+            [s for s in spans if s.get("trace_id") == ns.trace]
+        )
+        path = critical_path(spans, ns.trace)
+        if ns.json:
+            print(json.dumps({"tree": roots, "critical_path": path}))
+            return 0
+        for root in roots:
+            print("\n".join(render_tree(root)))
+        print("\ncritical path:")
+        for hop in path:
+            print(
+                f"  {hop['dur_s'] * 1e3:9.3f}ms "
+                f"(self {hop['self_s'] * 1e3:8.3f}ms)  {hop['name']}"
+            )
+        return 0
+
+    if ns.summary:
+        rows = summarize(spans)
+        if ns.json:
+            print(json.dumps(rows))
+            return 0
+        print(f"{'name':<28}{'count':>7}{'err':>5}{'mean_ms':>10}"
+              f"{'p50_ms':>10}{'p95_ms':>10}{'max_ms':>10}")
+        for r in rows:
+            print(
+                f"{r['name']:<28}{r['count']:>7}{r['errors']:>5}"
+                f"{r['mean_s'] * 1e3:>10.3f}{r['p50_s'] * 1e3:>10.3f}"
+                f"{r['p95_s'] * 1e3:>10.3f}{r['max_s'] * 1e3:>10.3f}"
+            )
+        return 0
+
+    rows = slowest(spans, top=ns.top, name=ns.name)
+    if ns.json:
+        print(json.dumps(rows))
+        return 0
+    for r in rows:
+        print(
+            f"{r['dur_s'] * 1e3:9.3f}ms  {r.get('name'):<24} "
+            f"trace={r.get('trace_id')} status={r.get('status')} "
+            f"attrs={r.get('attrs')}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # Piped into head/less and the reader closed: not an error.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)
