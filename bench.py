@@ -1335,6 +1335,24 @@ def chaos_goodput_phase():
     }
 
 
+def autoscale_phase():
+    """Closed-loop autoscaler A/B (tools/bench_autoscale.py): the same
+    seeded fault+traffic schedule — persistent straggler delay, worker
+    deaths, serving spike — run static vs autoscaled on the sim-cluster
+    backend. The autoscaled run must strictly beat the static goodput
+    fraction (asserted inside the harness's invariants). Host-only,
+    jax-free — runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_autoscale
+
+    r = bench_autoscale.run_bench()
+    return {f"autoscale_{k}" if not k.startswith("static_") else k: v
+            for k, v in r.items()}
+
+
 def rescale_phase():
     """Live elastic rescale N→N-1→N through the rescale coordinator
     (dlrover_tpu/testing/rescale_soak.py, "live" scenario): a worker is
@@ -1542,6 +1560,8 @@ _KEEP_KEYS = {
     "ce_auto_path",
     "soak_goodput_frac", "soak_mttr_mean_s", "soak_invariants",
     "rescale_to_first_step_s", "rescale_invariants",
+    "autoscale_goodput_frac", "static_goodput_frac",
+    "autoscale_decisions_total", "autoscale_time_to_mitigate_s",
     "fleet_tokens_per_s", "fleet_speedup_vs_single",
     "fleet_ttft_p99_s", "fleet_kill_ttft_p99_s",
     "fleet_kill_completed_frac",
@@ -1565,6 +1585,8 @@ _DROP_ORDER = (
     r"^serving_(static_|slots|requests|prefill_chunk|iterations"
     r"|retraces|truncated)",
     r"^soak_(faults|episodes|deaths|mttr_max)",
+    r"^(autoscale_(ckpt|stall|serve|fleet|dry_run|deaths|invariants"
+    r"|actuations|mitigate|goodput_gain)|static_(stall|serve))",
     r"^rescale_(plans|deaths|events|goodput|barrier|restore"
     r"|to_first_step_mean)",
     r"^fleet_(replicas|requests|single_|ttft_p50|kill_(tokens|reroutes"
@@ -1769,6 +1791,13 @@ def main():
         # resharded restore → scale back up; reports plan-to-first-step
         # seconds. Host + CPU, every platform.
         run_phase(result, "rescale", rescale_phase, est_s=45, cap_s=200)
+        # Closed-loop autoscaler A/B: static vs autoscaled under one
+        # seeded fault+traffic schedule on the sim-cluster backend
+        # (straggler evict, MTBF-driven ckpt cadence, fleet sizing).
+        # Host-only, every platform.
+        run_phase(
+            result, "autoscale", autoscale_phase, est_s=60, cap_s=240
+        )
     if platform != "cpu" and not fast:
         # Information-value order (VERDICT r4 #1c): headline compute +
         # CE + decode + longctx before the long tail.

@@ -1,0 +1,74 @@
+"""Closed-loop autoscaler: observe goodput signals, decide, actuate.
+
+The L1 "resource brain" control loop (docs/DESIGN.md §30): a
+:class:`~dlrover_tpu.autoscaler.signals.SignalBus` samples the live
+signal plane (per-rank step-time EWMAs + straggler scores, shard-queue
+depths, serving fleet load, fault history + observed MTBF, running
+goodput), a deterministic rule
+:class:`~dlrover_tpu.autoscaler.policy.RulePolicy` (hysteresis bands,
+per-action cooldowns) turns snapshots into typed
+:class:`~dlrover_tpu.autoscaler.policy.ScaleDecision`\\ s, and the
+:class:`~dlrover_tpu.autoscaler.loop.AutoScaler` loop actuates them —
+rescale-coordinator evictions, :class:`ScalePlan`\\ s against a
+``Scaler`` backend, serving-fleet add/drain, flash-ckpt cadence — with
+every decision landing in a ledger alongside the exact signal snapshot
+that triggered it. ``dry_run=True`` produces the same ledger with zero
+actuations.
+"""
+
+from dlrover_tpu.autoscaler.actuator import (
+    CadenceController,
+    FleetActuator,
+    TrainWorldActuator,
+)
+from dlrover_tpu.autoscaler.loop import AutoScaler, BrainPrior
+from dlrover_tpu.autoscaler.policy import (
+    ACTIONS,
+    EVICT_STRAGGLER,
+    GROW_FLEET,
+    GROW_WORLD,
+    SEED_WORLD,
+    SET_CKPT_INTERVAL,
+    SHRINK_FLEET,
+    SHRINK_WORLD,
+    DecisionLedger,
+    PolicyConfig,
+    RulePolicy,
+    ScaleDecision,
+)
+from dlrover_tpu.autoscaler.signals import (
+    FaultHistory,
+    SignalBus,
+    SignalSnapshot,
+    data_source,
+    fault_source,
+    fleet_source,
+    perf_source,
+)
+
+__all__ = [
+    "AutoScaler",
+    "BrainPrior",
+    "SignalBus",
+    "SignalSnapshot",
+    "FaultHistory",
+    "perf_source",
+    "data_source",
+    "fleet_source",
+    "fault_source",
+    "RulePolicy",
+    "PolicyConfig",
+    "ScaleDecision",
+    "DecisionLedger",
+    "ACTIONS",
+    "EVICT_STRAGGLER",
+    "GROW_WORLD",
+    "SHRINK_WORLD",
+    "GROW_FLEET",
+    "SHRINK_FLEET",
+    "SET_CKPT_INTERVAL",
+    "SEED_WORLD",
+    "TrainWorldActuator",
+    "FleetActuator",
+    "CadenceController",
+]

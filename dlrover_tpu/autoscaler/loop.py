@@ -1,0 +1,351 @@
+"""AutoScaler: the observe -> decide -> act loop, with a ledger.
+
+One tick = sample the SignalBus, run the policy, actuate (or record
+without acting in dry-run / advisory cases), append every decision to
+the ledger with its triggering snapshot. The loop can run as a daemon
+thread on a cadence (the masters do this) or be ticked synchronously
+(tests and the soak harness, which want deterministic pacing).
+
+Decisions emit ``autoscaler_*`` metrics and — when tracing is armed —
+one ``autoscaler.decision`` span each, carrying action/target/outcome,
+so a scale action shows up in the same trace plane as the RPCs and
+training steps it perturbs (§29).
+
+The optional :class:`BrainPrior` wires the §-brain cross-job optimizer
+in as a *prior*: at start the autoscaler may seed its initial
+world-size target from ``/optimize`` (a SEED_WORLD decision, through
+the same ledger/actuation path as everything else), and at stop it
+reports the achieved goodput back to ``/persist_metrics`` so the next
+job of this name starts smarter.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.autoscaler.policy import (
+    DecisionLedger,
+    RulePolicy,
+    ScaleDecision,
+    SEED_WORLD,
+)
+from dlrover_tpu.autoscaler.signals import SignalBus, SignalSnapshot
+from dlrover_tpu.common.log import logger
+
+
+def _metrics(registry=None):
+    from dlrover_tpu.observability.registry import default_registry
+
+    reg = registry or default_registry()
+    return {
+        "ticks": reg.counter(
+            "autoscaler_ticks_total",
+            "autoscaler observe/decide/act iterations",
+        ),
+        "decisions": reg.counter(
+            "autoscaler_decisions_total",
+            "scale decisions emitted, by action",
+            labelnames=("action",),
+        ),
+        "actuations": reg.counter(
+            "autoscaler_actuations_total",
+            "decisions actually actuated, by action",
+            labelnames=("action",),
+        ),
+        "errors": reg.counter(
+            "autoscaler_actuation_errors_total",
+            "actuations that raised, by action",
+            labelnames=("action",),
+        ),
+        "dry_run": reg.gauge(
+            "autoscaler_dry_run",
+            "1 when the loop is advisory-only (no actuations)",
+        ),
+        "ckpt_interval": reg.gauge(
+            "autoscaler_ckpt_interval_s",
+            "checkpoint cadence the autoscaler currently recommends",
+        ),
+    }
+
+
+class AutoScaler:
+    """The resource brain's control loop (docs/DESIGN.md §30)."""
+
+    def __init__(
+        self,
+        bus: SignalBus,
+        policy: Optional[RulePolicy] = None,
+        actuators: Optional[
+            Dict[str, Callable[[ScaleDecision], None]]
+        ] = None,
+        interval_s: float = 5.0,
+        dry_run: bool = False,
+        ledger_size: int = 512,
+        clock: Callable[[], float] = time.time,
+        registry=None,
+        brain_prior: Optional["BrainPrior"] = None,
+        job_name: str = "",
+    ):
+        self.bus = bus
+        self.policy = policy or RulePolicy()
+        self._actuators = dict(actuators or {})
+        self.interval_s = interval_s
+        self.dry_run = dry_run
+        self.ledger = DecisionLedger(ledger_size)
+        self._clock = clock
+        self._m = _metrics(registry)
+        self._m["dry_run"].set(1.0 if dry_run else 0.0)
+        self._brain = brain_prior
+        self._job_name = job_name
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seeded = False
+        self._completion_reported = False
+
+    # ---- wiring ------------------------------------------------------------
+
+    def bind(self, action: str, fn: Callable[[ScaleDecision], None]):
+        self._actuators[action] = fn
+        return self
+
+    def bind_all(self, bindings: Dict[str, Callable[[ScaleDecision], None]]):
+        self._actuators.update(bindings)
+        return self
+
+    # ---- one iteration -----------------------------------------------------
+
+    def tick(self) -> List[ScaleDecision]:
+        """Sample -> decide -> actuate/record. Synchronous drivers (the
+        soak harness, tests) call this directly; the daemon thread calls
+        it on the cadence."""
+        self._m["ticks"].inc()
+        snap = self.bus.sample()
+        if not self._seeded:
+            self._seeded = True
+            self._seed_from_brain(snap)
+        decisions = self.policy.decide(snap)
+        for decision in decisions:
+            self._handle(decision)
+        return decisions
+
+    def _handle(self, decision: ScaleDecision):
+        self._m["decisions"].inc(action=decision.action)
+        actuator = self._actuators.get(decision.action)
+        span = None
+        from dlrover_tpu.observability import tracing
+
+        tracer = tracing.active_tracer()
+        if tracer is not None:
+            span = tracer.start_span(
+                "autoscaler.decision",
+                attrs={
+                    "action": decision.action,
+                    "target": str(decision.target),
+                    "dry_run": self.dry_run,
+                },
+            )
+        if self.dry_run:
+            decision.outcome = "dry_run"
+        elif actuator is None:
+            decision.outcome = "advisory"
+        else:
+            try:
+                actuator(decision)
+                decision.outcome = "actuated"
+                self._m["actuations"].inc(action=decision.action)
+            except Exception as e:  # noqa: BLE001 — a failed actuation
+                # must not kill the loop; the ledger records the miss.
+                decision.outcome = f"error:{type(e).__name__}: {e}"[:200]
+                self._m["errors"].inc(action=decision.action)
+                logger.warning(
+                    "autoscaler actuation failed (%s -> %r): %s",
+                    decision.action, decision.target, e,
+                )
+        if decision.action == "set_ckpt_interval":
+            # Published even in dry-run/advisory mode: the gauge IS the
+            # recommendation channel for deployments with no push path.
+            self._m["ckpt_interval"].set(float(decision.target))
+        self.ledger.append(decision)
+        if span is not None:
+            span.set_attr("outcome", decision.outcome)
+            span.set_attr("reason", decision.reason[:200])
+            span.end(
+                status="ok"
+                if not decision.outcome.startswith("error") else "error"
+            )
+        logger.info(
+            "autoscaler decision #%d: %s -> %r (%s) [%s]",
+            decision.seq, decision.action, decision.target,
+            decision.reason, decision.outcome,
+        )
+
+    def _seed_from_brain(self, snap: SignalSnapshot):
+        if self._brain is None:
+            return
+        suggestion = self._brain.initial_world()
+        if not suggestion:
+            return
+        count = int(suggestion.get("worker_count", 0))
+        current = snap.get("world.size")
+        if count <= 0 or current is None:
+            return
+        # The prior's suggestion obeys the same legality as every other
+        # world move: snap DOWN to the nearest legal mesh shape and
+        # clamp to the configured bounds — a brain trained on another
+        # cluster must not order a world this rendezvous refuses.
+        cfg = self.policy.config
+        if cfg.legal_world_counts:
+            legal = [
+                c for c in sorted(set(cfg.legal_world_counts))
+                if c <= count
+            ]
+            if not legal:
+                return
+            count = legal[-1]
+        if cfg.max_world > 0:
+            count = min(count, cfg.max_world)
+        count = max(count, cfg.min_world)
+        if count == current:
+            return
+        self._handle(ScaleDecision(
+            action=SEED_WORLD,
+            target=count,
+            reason=(
+                f"brain prior: {suggestion.get('optimizer', '?')} "
+                f"optimizer suggests {count} workers from "
+                f"{suggestion.get('evidence_samples', 0)} past samples "
+                f"(current {current})"
+            ),
+            signals=dict(snap.values),
+            ts=snap.ts,
+        ))
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            "autoscaler loop started (interval %.1fs%s)",
+            self.interval_s, ", DRY RUN" if self.dry_run else "",
+        )
+
+    def _loop(self):
+        while not self._stopped.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("autoscaler tick failed")
+
+    def stop(self, success: bool = True):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._report_completion(success)
+
+    def _report_completion(self, success: bool):
+        if self._brain is None or self._completion_reported:
+            return
+        self._completion_reported = True
+        snap = self.bus.latest()
+        self._brain.report_outcome(
+            goodput=float((snap.get("perf.goodput") if snap else 0.0)
+                          or 0.0),
+            worker_count=int((snap.get("world.size") if snap else 0)
+                             or 0),
+            speed=float((snap.get("perf.speed") if snap else 0.0) or 0.0),
+            success=success,
+        )
+
+    # ---- dashboard surface -------------------------------------------------
+
+    def api_state(self, last: int = 50) -> Dict[str, object]:
+        """The ``/api/autoscaler`` payload: live signals, the recent
+        ledger, and the dry-run diff (decisions the loop took vs
+        actuations it performed — in dry-run the gap IS the diff)."""
+        snap = self.bus.latest()
+        decisions = self.ledger.entries(last=last)
+        return {
+            "enabled": True,
+            "dry_run": self.dry_run,
+            "interval_s": self.interval_s,
+            "sources": self.bus.source_names(),
+            "signals": (
+                {"seq": snap.seq, "ts": snap.ts, "values": snap.values}
+                if snap is not None else None
+            ),
+            "decisions": [d.to_dict() for d in decisions],
+            "decisions_total": self.ledger.decisions_total,
+            "actuations_total": self.ledger.actuations_total,
+            "dry_run_diff": {
+                "decisions_total": self.ledger.decisions_total,
+                "actuations_total": self.ledger.actuations_total,
+                "suppressed": (
+                    self.ledger.decisions_total
+                    - self.ledger.actuations_total
+                ),
+            },
+        }
+
+
+class BrainPrior:
+    """Cross-job prior over the brain service (§-brain): ask
+    ``/optimize`` for a starting world size, report the achieved
+    goodput back on completion. Every failure degrades to None/no-op —
+    an unreachable brain must never gate a job."""
+
+    def __init__(self, brain_addr: str, job_name: str,
+                 timeout_s: float = 5.0):
+        self._addr = brain_addr
+        self._job_name = job_name
+        self._timeout = timeout_s
+
+    def _post(self, path: str, payload: Dict) -> Optional[Dict]:
+        from dlrover_tpu.brain.client import _post
+
+        return _post(self._addr, path, payload, timeout=self._timeout)
+
+    def initial_world(self) -> Optional[Dict]:
+        try:
+            result = self._post(
+                "/optimize", {"job_name": self._job_name}
+            )
+        except Exception:  # noqa: BLE001 — prior only, never gate
+            logger.warning("brain prior unreachable; no seed")
+            return None
+        plan = (result or {}).get("plan")
+        if not isinstance(plan, dict) or not plan.get("worker_count"):
+            return None
+        return plan
+
+    def report_outcome(self, goodput: float, worker_count: int,
+                       speed: float = 0.0, success: bool = True):
+        """Achieved-goodput report-back: a runtime sample (so the
+        optimizer's per-count evidence grows) plus a completion record."""
+        try:
+            self._post("/persist_metrics", {
+                "kind": "runtime",
+                "record": {
+                    "job_name": self._job_name,
+                    "speed": speed,
+                    "goodput": goodput,
+                    "worker_count": worker_count,
+                },
+            })
+            self._post("/persist_metrics", {
+                "kind": "completion",
+                "record": {
+                    "job_name": self._job_name,
+                    "success": success,
+                    "goodput": goodput,
+                    "worker_count": worker_count,
+                },
+            })
+        except Exception:  # noqa: BLE001
+            logger.warning("brain outcome report failed")

@@ -56,6 +56,38 @@ def expected_goodput_pct(
     return 100.0 * mtbf_s / (mtbf_s + overhead + downtime)
 
 
+class MtbfTracker:
+    """Rolling observed mean time between failures.
+
+    The live counterpart of the constant ``mtbf_s`` the bench assumes:
+    the autoscaler feeds failure arrival timestamps in (node deaths,
+    worker SIGKILLs) and reads the windowed mean inter-arrival back out
+    to drive :func:`optimal_save_interval_s`. ``None`` until at least
+    ``min_failures`` arrivals landed — one failure is an anecdote, not
+    a rate.
+    """
+
+    def __init__(self, window: int = 32, min_failures: int = 2):
+        self._times = deque(maxlen=max(window, 2))
+        self._min_failures = max(min_failures, 2)
+
+    def record_failure(self, ts: float):
+        self._times.append(float(ts))
+
+    @property
+    def failures_seen(self) -> int:
+        return len(self._times)
+
+    def observed_mtbf_s(self) -> Optional[float]:
+        if len(self._times) < self._min_failures:
+            return None
+        times = sorted(self._times)
+        gaps = [b - a for a, b in zip(times, times[1:]) if b > a]
+        if not gaps:
+            return None
+        return sum(gaps) / len(gaps)
+
+
 class SaveCostTracker:
     """Rolling medians of measured save costs, feeding the autotuner."""
 

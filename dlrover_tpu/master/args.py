@@ -88,6 +88,47 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="enable the throughput-driven worker auto-scaler",
     )
     parser.add_argument(
+        "--autoscale_loop",
+        action="store_true",
+        default=False,
+        help="run the closed-loop autoscaler (docs/DESIGN.md §30): "
+        "watch goodput/straggler/queue/fault signals; actuate "
+        "straggler eviction, ckpt cadence (Young/Daly from observed "
+        "MTBF) and — with --autoscale_max_world — world resizes; "
+        "fleet-sizing decisions actuate where a router runs "
+        "in-process; decisions at /api/autoscaler",
+    )
+    parser.add_argument(
+        "--autoscale_dry_run",
+        action="store_true",
+        default=False,
+        help="autoscaler decides and ledgers but never actuates "
+        "(advisory mode)",
+    )
+    parser.add_argument(
+        "--autoscale_interval_s",
+        type=float,
+        default=5.0,
+        help="autoscaler decision-loop cadence in seconds",
+    )
+    parser.add_argument(
+        "--autoscale_max_world",
+        type=int,
+        default=0,
+        help="unpin the autoscaler's backlog-driven world resize up to "
+        "this many workers (0 = world pinned: only straggler eviction, "
+        "ckpt cadence and the brain seed actuate); clamped to "
+        "--legal_worker_counts when given",
+    )
+    parser.add_argument(
+        "--autoscale_ckpt_interval_s",
+        type=float,
+        default=60.0,
+        help="starting flash-ckpt cadence the autoscaler retunes from "
+        "observed MTBF (Young/Daly); published on the "
+        "autoscaler_ckpt_interval_s gauge and /api/autoscaler",
+    )
+    parser.add_argument(
         "--legal_worker_counts",
         type=str,
         default="",

@@ -160,6 +160,7 @@ class DashboardServer:
         task_manager=None,
         metric_context=None,
         trace_aggregator=None,
+        autoscaler=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
@@ -167,6 +168,7 @@ class DashboardServer:
         self._task_manager = task_manager
         self._metric_context = metric_context
         self._trace_aggregator = trace_aggregator
+        self._autoscaler = autoscaler
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self.port = 0
@@ -233,6 +235,15 @@ class DashboardServer:
                         json.dumps(dashboard._stragglers()),
                         "application/json",
                     )
+                elif self.path == "/api/autoscaler":
+                    # The §30 resource brain: live signal snapshot,
+                    # recent decision ledger (every entry with the
+                    # signals that triggered it), dry-run diff.
+                    self._send(
+                        200,
+                        json.dumps(dashboard._autoscaler_state()),
+                        "application/json",
+                    )
                 elif self.path.startswith("/api/traces"):
                     self._send(
                         200,
@@ -295,6 +306,14 @@ class DashboardServer:
         if callable(report):
             return report()
         return {"ranks": {}, "stragglers": [], "median_step_time_s": 0.0}
+
+    def _autoscaler_state(self):
+        if self._autoscaler is None:
+            return {"enabled": False}
+        try:
+            return self._autoscaler.api_state()
+        except Exception as e:  # noqa: BLE001 — dashboard never 500s
+            return {"enabled": True, "error": f"{type(e).__name__}: {e}"}
 
     def _traces(self, path: str):
         """``/api/traces`` -> recent trace summaries;

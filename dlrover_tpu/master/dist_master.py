@@ -85,6 +85,11 @@ class DistributedJobMaster:
         topology_aware: bool = False,
         node_group_size: int = 0,
         metric_endpoints=None,
+        autoscale_loop: bool = False,
+        autoscale_dry_run: bool = False,
+        autoscale_interval_s: float = 5.0,
+        autoscale_max_world: int = 0,
+        autoscale_ckpt_interval_s: float = 60.0,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -216,6 +221,30 @@ class DistributedJobMaster:
             self.perf_monitor,
             reporter=stats_reporter,
         )
+        # §30 closed-loop autoscaler (self.autoscaler — distinct from
+        # the legacy throughput-driven self.auto_scaler below): observe
+        # the live signal plane, decide through deterministic rules,
+        # actuate world changes via the proven execute_plan path +
+        # rescale-coordinator evictions.
+        self.autoscaler = None
+        self.fault_history = None
+        if (auto_scale and autoscale_loop and autoscale_max_world > 0):
+            # Two independent world controllers issuing conflicting
+            # targets would oscillate the rendezvous window; refuse the
+            # combination instead of racing.
+            raise ValueError(
+                "--auto_scale and --autoscale_loop with "
+                "--autoscale_max_world both drive the worker count; "
+                "pick one world controller"
+            )
+        if autoscale_loop:
+            self._build_autoscaler(
+                scaler, autoscale_dry_run, autoscale_interval_s,
+                brain_addr,
+                max_world=autoscale_max_world,
+                legal_worker_counts=legal_worker_counts,
+                ckpt_interval_s=autoscale_ckpt_interval_s,
+            )
         self.dashboard = None
         if dashboard_port >= 0:
             from dlrover_tpu.master.dashboard import DashboardServer
@@ -234,6 +263,7 @@ class DistributedJobMaster:
                     else None
                 ),
                 trace_aggregator=self.trace_aggregator,
+                autoscaler=self.autoscaler,
             )
         self.auto_scaler = None
         if auto_scale:
@@ -261,6 +291,139 @@ class DistributedJobMaster:
                 optimizer,
                 rdzv_managers=self.rdzv_managers,
             )
+
+    def _build_autoscaler(self, scaler, dry_run: bool, interval_s: float,
+                          brain_addr: str, max_world: int = 0,
+                          legal_worker_counts=None,
+                          ckpt_interval_s: float = 60.0):
+        from dlrover_tpu.autoscaler import (
+            AutoScaler,
+            BrainPrior,
+            CadenceController,
+            EVICT_STRAGGLER,
+            FaultHistory,
+            GROW_WORLD,
+            PolicyConfig,
+            RulePolicy,
+            SEED_WORLD,
+            SET_CKPT_INTERVAL,
+            SHRINK_WORLD,
+            SignalBus,
+            data_source,
+            fault_source,
+            fleet_source,
+            perf_source,
+        )
+        from dlrover_tpu.master.node.event_callback import (
+            NodeEventCallback,
+        )
+        from dlrover_tpu.master.node.job_auto_scaler import (
+            AllreduceTrainingAutoScaler,
+        )
+        from dlrover_tpu.master.resource.optimizer import ResourcePlan
+
+        self.fault_history = FaultHistory()
+        history = self.fault_history
+
+        class _FaultFeed(NodeEventCallback):
+            """Node deaths feed the observed-MTBF tracker."""
+
+            def on_node_started(self, node):
+                pass
+
+            def on_node_succeeded(self, node):
+                pass
+
+            def on_node_deleted(self, node):
+                pass
+
+            def on_node_failed(self, node):
+                history.record_failure()
+
+        self.job_manager.add_node_event_callback(_FaultFeed())
+        # The cadence knob: SET_CKPT_INTERVAL actuates it, the "ckpt"
+        # source feeds the policy the interval it is steering (without
+        # the source the Young/Daly rule can never fire), and trainers
+        # poll it as self.ckpt_cadence.interval_s().
+        self.ckpt_cadence = CadenceController(ckpt_interval_s)
+        bus = (
+            SignalBus()
+            .add_source("perf", perf_source(self.perf_monitor))
+            .add_source("data", data_source(self.task_manager))
+            .add_source("fleet", fleet_source())
+            .add_source("fault", fault_source(history))
+            .add_source("ckpt", self.ckpt_cadence.as_source())
+            .add_source("world", lambda: {
+                "size": len(
+                    self.job_manager.worker_manager.alive_nodes()
+                ),
+            })
+        )
+        # World moves are opt-in (max_world > 0 unpins the backlog
+        # rules). With a legal-counts list the cap is clamped to the
+        # largest legal shape AND every grow/shrink targets the next
+        # legal count (policy._next_world) — the loop can never order
+        # a world the rendezvous would refuse to form.
+        if max_world > 0 and legal_worker_counts:
+            legal_caps = [
+                c for c in legal_worker_counts if c <= max_world
+            ]
+            max_world = max(legal_caps) if legal_caps else 0
+        policy = RulePolicy(PolicyConfig(
+            max_world=max_world,
+            legal_world_counts=(
+                list(legal_worker_counts) if legal_worker_counts
+                else None
+            ),
+        ))
+        # World moves reuse the proven execute_plan path (group resize
+        # through the scaler + rendezvous window update); its optimizer
+        # is never consulted — the §30 policy IS the optimizer here.
+        executor = AllreduceTrainingAutoScaler(
+            self.job_manager, scaler, optimizer=None,
+            rdzv_managers=self.rdzv_managers,
+        )
+
+        def set_world(decision):
+            plan = ResourcePlan(comment=decision.reason[:120])
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(count=int(decision.target))
+            )
+            executor.execute_plan(plan)
+
+        def evict(decision):
+            # The coordinator cuts the scale-down plan; the job
+            # manager's normal relaunch machinery replaces the seat.
+            rank = int(decision.target)
+            if not self.rescale_coordinator.evict_worker(rank):
+                raise ValueError(
+                    f"rank {decision.target} not in the live set"
+                )
+            # The replacement must not inherit the evictee's slow
+            # step-time EWMA (an evict loop on a healthy worker).
+            self.perf_monitor.reset_rank(rank)
+
+        self.autoscaler = AutoScaler(
+            bus,
+            policy=policy,
+            actuators={
+                EVICT_STRAGGLER: evict,
+                GROW_WORLD: set_world,
+                SHRINK_WORLD: set_world,
+                SEED_WORLD: set_world,
+                # The cadence lands on the controller; workers with no
+                # push channel read the recommendation off the
+                # autoscaler_ckpt_interval_s gauge + /api/autoscaler.
+                SET_CKPT_INTERVAL: self.ckpt_cadence.apply,
+            },
+            interval_s=interval_s,
+            dry_run=dry_run,
+            brain_prior=(
+                BrainPrior(brain_addr, self.job_name)
+                if brain_addr else None
+            ),
+            job_name=self.job_name,
+        )
 
     def _build_diagnosis_master(self, pre_check: bool):
         from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
@@ -369,6 +532,17 @@ class DistributedJobMaster:
             ),
             node_group_size=getattr(args, "node_unit", 0),
             topology_aware=getattr(args, "topology_aware", False),
+            autoscale_loop=getattr(args, "autoscale_loop", False),
+            autoscale_dry_run=getattr(args, "autoscale_dry_run", False),
+            autoscale_interval_s=getattr(
+                args, "autoscale_interval_s", 5.0
+            ),
+            autoscale_max_world=getattr(
+                args, "autoscale_max_world", 0
+            ),
+            autoscale_ckpt_interval_s=getattr(
+                args, "autoscale_ckpt_interval_s", 60.0
+            ),
         )
 
     # ---- lifecycle ---------------------------------------------------------
@@ -397,6 +571,8 @@ class DistributedJobMaster:
             self.dashboard.start()
         if self.auto_scaler is not None:
             self.auto_scaler.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         if self.diagnosis_master is not None:
             self.diagnosis_master.start_observing()
         logger.info(
@@ -473,6 +649,12 @@ class DistributedJobMaster:
             self.dashboard.stop()
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
+        if self.autoscaler is not None:
+            # Reports the achieved goodput back to the brain (the §30
+            # prior's learning half) before the loop goes down.
+            self.autoscaler.stop(
+                success=self.exit_reason == JobExitReason.SUCCEEDED
+            )
         if self.diagnosis_master is not None:
             self.diagnosis_master.stop_observing()
         self.task_manager.stop()

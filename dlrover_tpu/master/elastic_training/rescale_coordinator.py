@@ -239,6 +239,32 @@ class RescaleCoordinator:
             if self._plan is not None and rank in self._plan.world:
                 self._make_plan_locked("node_lost")
 
+    def evict_worker(self, rank: int, reason: str = "straggler_evict"
+                     ) -> bool:
+        """Deliberate eviction (the §30 autoscaler condemning a flagged
+        straggler): unlike :meth:`note_worker_lost` the rank is still
+        ALIVE — it is removed from the live set and, when it sat in the
+        current plan's world, a superseding plan is cut under
+        ``reason`` so the survivors re-mesh without it. The evictee
+        learns of its eviction from the plan itself (absence from
+        ``world`` is the eviction notice) and exits cleanly; its
+        replacement re-joins through the normal scale-up path."""
+        with self._lock:
+            if rank not in self._live:
+                return False
+            del self._live[rank]
+            self._rank_group.pop(rank, None)
+            self._join_seq.pop(rank, None)
+            self._m["live"].set(len(self._live))
+            self._m["evicted"].inc()
+            if self._plan is not None and rank in self._plan.world:
+                self._make_plan_locked(reason)
+            logger.info(
+                "rescale: rank %d evicted (%s); %d live workers remain",
+                rank, reason, len(self._live),
+            )
+            return True
+
     def note_ckpt_step(self, step: int, committed: bool):
         if committed:
             with self._lock:

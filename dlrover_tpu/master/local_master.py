@@ -32,6 +32,9 @@ class LocalJobMaster:
         transport: str = "grpc",
         batch_config=None,
         devices_per_node: int = 1,
+        autoscale_loop: bool = False,
+        autoscale_dry_run: bool = False,
+        autoscale_interval_s: float = 5.0,
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -85,6 +88,62 @@ class LocalJobMaster:
         self.port = self._server.port
         self._node_num = node_num
         self._stopped = threading.Event()
+        # §30 autoscaler, standalone flavor: full signal plane
+        # (straggler scores, shard queues, fleet load, fault history)
+        # + the rescale coordinator's eviction actuation. The local
+        # master has no cluster scaler, so world-resize decisions stay
+        # advisory — visible in the ledger and metrics, acted on by
+        # the operator.
+        self.autoscaler = None
+        self.fault_history = None
+        self.ckpt_cadence = None
+        if autoscale_loop:
+            from dlrover_tpu.autoscaler import (
+                AutoScaler,
+                CadenceController,
+                EVICT_STRAGGLER,
+                FaultHistory,
+                SET_CKPT_INTERVAL,
+                SignalBus,
+                data_source,
+                fault_source,
+                fleet_source,
+                perf_source,
+            )
+
+            self.fault_history = FaultHistory()
+            # The cadence knob: the "ckpt" source makes the Young/Daly
+            # rule live once an MTBF is observed; a standalone trainer
+            # polls master.ckpt_cadence.interval_s() (or the gauge).
+            self.ckpt_cadence = CadenceController(60.0)
+            bus = (
+                SignalBus()
+                .add_source("perf", perf_source(self.perf_monitor))
+                .add_source("data", data_source(self.task_manager))
+                .add_source("fleet", fleet_source())
+                .add_source("fault", fault_source(self.fault_history))
+                .add_source("ckpt", self.ckpt_cadence.as_source())
+            )
+
+            def evict(decision):
+                rank = int(decision.target)
+                if not self.rescale_coordinator.evict_worker(rank):
+                    raise ValueError(
+                        f"rank {decision.target} not in the live set"
+                    )
+                # Fresh EWMA for the seat's next occupant.
+                self.perf_monitor.reset_rank(rank)
+
+            self.autoscaler = AutoScaler(
+                bus,
+                actuators={
+                    EVICT_STRAGGLER: evict,
+                    SET_CKPT_INTERVAL: self.ckpt_cadence.apply,
+                },
+                interval_s=autoscale_interval_s,
+                dry_run=autoscale_dry_run,
+                job_name=job_name,
+            )
 
     def _build_diagnosis_master(self):
         from dlrover_tpu.diagnosis.diagnosis_manager import DiagnosisManager
@@ -124,6 +183,8 @@ class LocalJobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         self.diagnosis_master.start_observing()
         logger.info(
             "local master [%s] serving on port %d", self.job_name, self.port
@@ -167,6 +228,8 @@ class LocalJobMaster:
 
     def stop(self):
         self._stopped.set()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.diagnosis_master.stop_observing()
         self.task_manager.stop()
         self.job_manager.stop()

@@ -33,6 +33,13 @@ def run(args) -> int:
             transport=args.transport,
             batch_config=batch_config,
             devices_per_node=args.devices_per_node,
+            autoscale_loop=getattr(args, "autoscale_loop", False),
+            autoscale_dry_run=getattr(
+                args, "autoscale_dry_run", False
+            ),
+            autoscale_interval_s=getattr(
+                args, "autoscale_interval_s", 5.0
+            ),
         )
     else:
         try:

@@ -168,6 +168,18 @@ class PerfMonitor:
         for rank, info in self.straggler_report()["ranks"].items():
             self._straggler_gauge.set(info["score"], rank=str(rank))
 
+    def reset_rank(self, rank: int):
+        """Forget one rank's step-time history — called when the seat's
+        OCCUPANT changes (straggler evicted, node replaced): the
+        replacement must not inherit its predecessor's slow EWMA and
+        report count, or a 3x-median ghost score re-flags a healthy
+        worker for several reports (an evict loop at real step
+        times)."""
+        with self._lock:
+            self._rank_step_ewma.pop(rank, None)
+            self._rank_step_reports.pop(rank, None)
+        self._straggler_gauge.set(0.0, rank=str(rank))
+
     @property
     def global_step(self) -> int:
         with self._lock:

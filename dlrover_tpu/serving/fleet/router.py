@@ -37,14 +37,20 @@ explicitly failed exactly once**. The pieces:
   elsewhere. The router restarts dead replicas after the breaker's
   cooldown and re-admits them through half-open probes.
 
-The router is single-threaded by design: every structure is owned by
-the pump (``step()``), driven by the caller or by ``serve_forever``-
-style loops; replicas do their work on their own threads/processes and
-communicate only through their mailboxes. With an injected clock and
-fake replicas the whole policy surface is unit-testable without sleeps.
+The router is pump-driven by design: every structure is owned by the
+pump (``step()``), driven by the caller or by ``serve_forever``-style
+loops; replicas do their work on their own threads/processes and
+communicate only through their mailboxes. A router-wide RLock
+serializes the public surface (``step``/``submit``/``results`` and the
+live-sizing verbs ``add_replica``/``drain_replica``) so a §30
+autoscaler thread can resize the fleet against a pumping router;
+uncontended, the lock is one acquire per pump. With an injected clock
+and fake replicas the whole policy surface is unit-testable without
+sleeps.
 """
 
 import random
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -138,7 +144,12 @@ class FleetRequest:
 
 
 class FleetRouter:
-    """See module docstring. Not thread-safe: one pump drives it."""
+    """See module docstring. One pump thread drives ``step()``; the
+    live-sizing surface (``add_replica``/``drain_replica``, the §30
+    autoscaler's actuation path) and ``submit`` may be called from
+    OTHER threads — a router-wide RLock serializes them against the
+    pump, so a drain can never yank ``_replicas``/``_ledger`` out from
+    under a step iteration."""
 
     def __init__(
         self,
@@ -149,6 +160,7 @@ class FleetRouter:
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
+        self._lock = threading.RLock()
         self.config = config or RouterConfig()
         self._clock = clock
         self.metrics = fleet_metrics(registry)
@@ -175,6 +187,11 @@ class FleetRouter:
         # long-lived router does not grow RSS with every request ever
         # served (callers keep their own FleetRequest handles).
         self._done_order: Deque[str] = deque()
+        # Requests that went terminal OUTSIDE a step() (a drain's
+        # reclaim can terminal-fail deadline/budget-exhausted victims):
+        # delivered by the NEXT step so run_until_idle's "returns every
+        # request that went terminal" contract holds.
+        self._orphan_done: List[FleetRequest] = []
         self._live_accepted = 0   # accepted, no terminal result yet
         self._last_restart: Dict[str, float] = {}
         self._service_lat: Deque[float] = deque(maxlen=256)
@@ -201,11 +218,106 @@ class FleetRouter:
             h.observe_heartbeat(now)
 
     def stop(self) -> None:
-        for replica in self._replicas.values():
+        # Snapshot under the lock (an autoscaler thread may be
+        # resizing), stop outside it (subprocess teardown can block).
+        with self._lock:
+            replicas = list(self._replicas.values())
+        for replica in replicas:
             try:
                 replica.stop()
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 pass
+
+    # ---- live fleet sizing (the §30 autoscaler's actuation surface) --------
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def add_replica(self, replica, start: bool = True,
+                    wait_ready: bool = False,
+                    timeout_s: float = 120.0) -> None:
+        """Grow the fleet live: register (and by default start) a new
+        replica. It enters HEALTHY with a fresh heartbeat — the boot
+        grace the breaker's missed-heartbeat strikes then cover, the
+        same contract a restart gets."""
+        rid = replica.replica_id
+        with self._lock:
+            if rid in self._replicas:
+                raise ValueError(f"duplicate replica_id {rid!r}")
+        # Boot OUTSIDE the router lock: a subprocess replica's start is
+        # seconds of interpreter/JAX init — holding the lock would
+        # freeze the pump (and every in-flight request) for the whole
+        # boot, exactly during the overload a GROW decision answers.
+        if start:
+            replica.start()
+            if wait_ready and not replica.wait_ready(timeout_s):
+                # The caller asked to block until serving: a boot
+                # timeout must surface, not register a mute replica
+                # as HEALTHY.
+                try:
+                    replica.stop()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                raise TimeoutError(
+                    f"replica {rid} not ready within {timeout_s:.0f}s"
+                )
+        with self._lock:
+            if rid in self._replicas:
+                # Lost a register race while booting: this instance is
+                # surplus, not fleet state.
+                if start:
+                    try:
+                        replica.stop()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                raise ValueError(f"duplicate replica_id {rid!r}")
+            self._replicas[rid] = replica
+            self._ledger[rid] = {}
+            self._health[rid] = health_lib.ReplicaHealth(
+                rid,
+                policy=self.config.health,
+                clock=self._clock,
+                on_transition=self._make_transition_hook(rid),
+            )
+            self.metrics.replica_state.set(0, replica=rid)
+            self._health[rid].observe_heartbeat(self._clock())
+            logger.info("fleet replica %s added (%d replicas)",
+                        rid, len(self._replicas))
+
+    def drain_replica(self, replica_id, stop: bool = True) -> bool:
+        """Shrink the fleet live: reclaim the replica's in-flight
+        ledger back onto the queue (the crash-re-route path, so nothing
+        is lost or duplicated), drop it from dispatch, and stop it.
+        Refuses to drain the last replica — a fleet of zero is an
+        outage, not a scale decision."""
+        rid = str(replica_id)
+        with self._lock:
+            if rid not in self._replicas:
+                return False
+            if len(self._replicas) <= 1:
+                raise ValueError(
+                    "refusing to drain the last fleet replica"
+                )
+            now = self._clock()
+            newly_done: List[FleetRequest] = []
+            self._reclaim(rid, now, newly_done)
+            # Terminal results produced by the reclaim surface from the
+            # next step(), not silently only in results().
+            self._orphan_done.extend(newly_done)
+            replica = self._replicas.pop(rid)
+            self._health.pop(rid, None)
+            self._ledger.pop(rid, None)
+            self._last_restart.pop(rid, None)
+            remaining = len(self._replicas)
+        if stop:
+            try:
+                replica.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        logger.info("fleet replica %s drained (%d replicas remain)",
+                    rid, remaining)
+        return True
 
     # ---- submission --------------------------------------------------------
 
@@ -216,6 +328,20 @@ class FleetRouter:
         temperature: float = 0.0,
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
+    ) -> FleetRequest:
+        with self._lock:
+            return self._submit_locked(
+                prompt, max_new_tokens, temperature, deadline_s,
+                request_id,
+            )
+
+    def _submit_locked(
+        self,
+        prompt,
+        max_new_tokens: int,
+        temperature: float,
+        deadline_s: Optional[float],
+        request_id: Optional[str],
     ) -> FleetRequest:
         now = self._clock()
         self._seq += 1
@@ -279,8 +405,13 @@ class FleetRouter:
         """One router iteration: drain replica mailboxes, advance
         health, reclaim/re-route, shed expired, dispatch, hedge.
         Returns requests that became terminal THIS call."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> List[FleetRequest]:
         now = self._clock()
-        newly_done: List[FleetRequest] = []
+        newly_done: List[FleetRequest] = list(self._orphan_done)
+        self._orphan_done.clear()
         self._drain_replicas(now, newly_done)
         self._check_replicas(now, newly_done)
         # restart() above can block for seconds (subprocess teardown):
@@ -332,14 +463,16 @@ class FleetRouter:
         return done
 
     def results(self) -> Dict[str, FleetResult]:
-        return {
-            rid: r.result
-            for rid, r in self._requests.items()
-            if r.result is not None
-        }
+        with self._lock:
+            return {
+                rid: r.result
+                for rid, r in self._requests.items()
+                if r.result is not None
+            }
 
     def health_state(self, replica_id: str) -> str:
-        return self._health[str(replica_id)].state
+        with self._lock:
+            return self._health[str(replica_id)].state
 
     # ---- completions -------------------------------------------------------
 

@@ -61,6 +61,14 @@ EPISODE_KINDS = (
     # (delegated to dlrover_tpu/testing/fleet_soak.py). Appended so
     # episodes 0-3 keep their (seed, episode) -> plan identity.
     "replica_kill_reroute",
+    # Episode 5: the §30 closed-loop autoscaler under a persistent
+    # per-rank delay at the step fault point plus worker deaths and a
+    # serving-traffic spike — the autoscaled run must flag/evict/
+    # replace the straggler within bounded decision windows and
+    # strictly beat the static run's goodput fraction (delegated to
+    # dlrover_tpu/testing/autoscale_soak.py). Appended so episodes 0-4
+    # keep their (seed, episode) -> plan identity.
+    "straggler_evict",
 )
 
 
@@ -175,6 +183,12 @@ def build_episode_plan(
                           nth=1, rule_id="shm-image-lost"),
             ], seed=ep_seed, label="gen1"),
         ]
+    elif kind == "straggler_evict":
+        # The sim-job fault schedule (persistent per-node delay at the
+        # step fault point + seeded worker deaths) is derived in
+        # autoscale_soak.build_autoscale_plan from the same ep_seed;
+        # the runner itself injects nothing extra.
+        pass
     elif kind == "replica_kill_reroute":
         # The per-replica SIGKILL schedule is derived in
         # fleet_soak.build_fleet_schedules (same ep_seed); the runner
@@ -497,6 +511,8 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
         return _run_fleet_kind(
             seed, episode, plan, cfg, work_dir, artifact_dir
         )
+    if plan.kind == "straggler_evict":
+        return _run_autoscale_kind(seed, episode, cfg)
     ep_dir = os.path.join(work_dir, f"soak-s{seed}-e{episode}")
     shutil.rmtree(ep_dir, ignore_errors=True)
     os.makedirs(os.path.join(ep_dir, "flight"), exist_ok=True)
@@ -735,6 +751,32 @@ def _run_fleet_kind(seed, episode, plan, cfg, work_dir, artifact_dir):
             artifact_dir=artifact_dir,
             runner_schedule=plan.runner_schedule,
         )
+    except SoakInvariantError:
+        print(
+            f"  repro: python tools/chaos_soak.py --seed {seed} "
+            f"--episode {episode}",
+            file=sys.stderr, flush=True,
+        )
+        raise
+
+
+def _run_autoscale_kind(seed, episode, cfg):
+    """Episode kind 5 (straggler_evict): delegate to the closed-loop
+    autoscaler harness
+    — the same seeded fault+traffic schedule run static, dry-run and
+    autoscaled; the autoscaled run must evict the delayed straggler
+    within bounded decision windows and strictly beat the static
+    goodput fraction. The report is already soak-shaped."""
+    from dlrover_tpu.testing.autoscale_soak import (
+        AutoscaleSoakConfig,
+        run_autoscale_episode,
+    )
+
+    acfg = AutoscaleSoakConfig(
+        watchdog_s=min(cfg.watchdog_s, 120.0),
+    )
+    try:
+        return run_autoscale_episode(seed, episode=episode, cfg=acfg)
     except SoakInvariantError:
         print(
             f"  repro: python tools/chaos_soak.py --seed {seed} "

@@ -53,3 +53,8 @@ def pytest_configure(config):
         "trace: cross-process distributed tracing + straggler/hang "
         "diagnosis plane — docs/DESIGN.md §29",
     )
+    config.addinivalue_line(
+        "markers",
+        "autoscale: closed-loop autoscaler (signal bus, rule policy, "
+        "actuators, static-vs-autoscaled soak A/B) — docs/DESIGN.md §30",
+    )

@@ -1,0 +1,69 @@
+"""Autoscaler A/B bench: static vs closed-loop under one seeded schedule.
+
+Runs the ``straggler_evict`` chaos scenario
+(``dlrover_tpu/testing/autoscale_soak.py``) — a deterministic
+sim-cluster training job with a persistent per-rank delay injected at
+the step fault point, seeded worker deaths and a serving-traffic spike
+— once with everything pinned (static) and once with the §30
+closed-loop autoscaler actuating evictions, ckpt-cadence retunes and
+fleet sizing. Prints one JSON line with both goodput fractions, the
+decision count and the straggler time-to-mitigate; wired into bench.py
+as the ``autoscale`` phase.
+
+    python tools/bench_autoscale.py [--seed 0]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrover_tpu.testing.autoscale_soak import (  # noqa: E402
+    AutoscaleSoakConfig,
+    run_autoscale_episode,
+)
+
+
+def run_bench(seed: int = 0,
+              cfg: AutoscaleSoakConfig = None) -> dict:
+    rep = run_autoscale_episode(
+        seed, cfg=cfg or AutoscaleSoakConfig()
+    )
+    return {
+        "goodput_frac": rep["autoscale_goodput_frac"],
+        "static_goodput_frac": rep["static_goodput_frac"],
+        "goodput_gain": round(
+            rep["autoscale_goodput_frac"]
+            - rep["static_goodput_frac"], 4
+        ),
+        "decisions_total": rep["autoscale_decisions_total"],
+        "actuations_total": rep["autoscale_actuations_total"],
+        "time_to_mitigate_s": rep["autoscale_time_to_mitigate_s"],
+        "mitigate_windows": rep["autoscale_mitigate_windows"],
+        "ckpt_interval_s": rep["autoscale_ckpt_interval_s"],
+        "ckpt_retunes": rep["autoscale_ckpt_retunes"],
+        "stall_s": rep["autoscale_stall_s"],
+        "static_stall_s": rep["static_stall_s"],
+        "serve_backlog_end": rep["autoscale_serve_backlog_end"],
+        "static_serve_backlog_end": rep["static_serve_backlog_end"],
+        "fleet_grow_events": rep["autoscale_fleet_grow_events"],
+        "fleet_shrink_events": rep["autoscale_fleet_shrink_events"],
+        "dry_run_decisions": rep.get("dry_run_decisions_total", 0),
+        "dry_run_actuations": rep.get("dry_run_actuations_total", 0),
+        "deaths": rep["deaths"],
+        "invariants": rep["invariants"],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="autoscaler A/B bench")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    print(json.dumps(run_bench(seed=args.seed)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

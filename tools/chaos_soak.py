@@ -1,10 +1,11 @@
 """Seeded chaos-soak CLI: drive the whole stack through reproducible
 fault episodes and assert the five system invariants.
 
-    python tools/chaos_soak.py --seed 0 --episodes 5
+    python tools/chaos_soak.py --seed 0 --episodes 6
     python tools/chaos_soak.py --seed 0 --episode 1      # repro one
     python tools/chaos_soak.py --seed 0 --episode 3      # rescale kill
     python tools/chaos_soak.py --seed 0 --episode 4      # fleet reroute
+    python tools/chaos_soak.py --seed 0 --episode 5      # autoscaler A/B
 
 Each episode runs an in-process master, worker subprocesses and a
 serving engine under a deterministic seeded fault schedule (worker
@@ -18,9 +19,15 @@ Episode 4 is the serving-fleet ``replica_kill_reroute`` episode
 (``dlrover_tpu/testing/fleet_soak.py``): a router over N subprocess
 serving replicas has one replica SIGKILLed mid-decode; every accepted
 request must complete or be explicitly failed exactly once and the
-victim's breaker must walk BROKEN → HALF_OPEN → HEALTHY. The
+victim's breaker must walk BROKEN → HALF_OPEN → HEALTHY. Episode 5 is
+the closed-loop autoscaler episode
+(``dlrover_tpu/testing/autoscale_soak.py``): one seeded fault+traffic
+schedule (persistent per-rank delay at the step fault point, worker
+deaths, a serving spike) run static, dry-run and autoscaled — the
+autoscaled run must evict the straggler within bounded decision
+windows and strictly beat the static goodput fraction. The
 implementation and the invariant definitions live in
-``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26-§28); exit code 0
+``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26-§30); exit code 0
 means every episode held every invariant. Prints one JSON summary line
 with goodput fraction and per-fault MTTR — the same numbers
 ``bench.py``'s ``chaos_goodput`` phase reports.
@@ -44,9 +51,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="seeded chaos soak")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--episodes", type=int, default=5,
-        help="episode count; 5 covers the full fault matrix incl. "
-        "kill_during_rescale and replica_kill_reroute",
+        "--episodes", type=int, default=6,
+        help="episode count; 6 covers the full fault matrix incl. "
+        "kill_during_rescale, replica_kill_reroute and the "
+        "straggler_evict autoscaler A/B",
     )
     parser.add_argument(
         "--episode", type=int, default=None,
