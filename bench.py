@@ -1557,6 +1557,8 @@ _KEEP_KEYS = {
     "data_pipe_records_per_s", "data_pipe_fetch_wait_frac",
     "serving_tokens_per_s", "serving_speedup_vs_static",
     "serving_ttft_p50_s", "serving_ttft_p99_s", "serving_slot_util",
+    "serving_kv_effective_slots", "serving_prefix_hit_rate",
+    "serving_paged_vs_flat_tokens_per_s",
     "ce_auto_path",
     "soak_goodput_frac", "soak_mttr_mean_s", "soak_invariants",
     "rescale_to_first_step_s", "rescale_invariants",
@@ -1583,7 +1585,9 @@ _DROP_ORDER = (
     r"^data_pipe_(records$|shard_size|batch_size|rpc_latency|step_ms"
     r"|sync_|rpcs$)",
     r"^serving_(static_|slots|requests|prefill_chunk|iterations"
-    r"|retraces|truncated)",
+    r"|retraces|truncated|flat_effective|paged_(tokens|retraces"
+    r"|token_exact|block)|prefix_(hits|ttft|prefill)"
+    r"|kv_(preemptions|cow))",
     r"^soak_(faults|episodes|deaths|mttr_max)",
     r"^(autoscale_(ckpt|stall|serve|fleet|dry_run|deaths|invariants"
     r"|actuations|mitigate|goodput_gain)|static_(stall|serve))",

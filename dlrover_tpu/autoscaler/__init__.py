@@ -43,6 +43,7 @@ from dlrover_tpu.autoscaler.signals import (
     data_source,
     fault_source,
     fleet_source,
+    kvpool_source,
     perf_source,
 )
 
@@ -56,6 +57,7 @@ __all__ = [
     "data_source",
     "fleet_source",
     "fault_source",
+    "kvpool_source",
     "RulePolicy",
     "PolicyConfig",
     "ScaleDecision",

@@ -231,6 +231,37 @@ def fleet_source(registry=None) -> Callable[[], Dict[str, object]]:
     return fn
 
 
+def kvpool_source(engine) -> Callable[[], Dict[str, object]]:
+    """Paged-KV memory pressure + SLO-class queue depths from a
+    :class:`~dlrover_tpu.serving.kvpool.PagedServingEngine` (§31).
+    The autoscaler's memory eye: ``blocks_free_frac`` falling while
+    per-class queues grow says the fleet is BLOCK-bound, not
+    replica-bound — grow capacity (or shed batch-class admission)
+    before TTFT collapses."""
+
+    def fn() -> Dict[str, object]:
+        stats = engine.kv_stats()
+        total = max(stats.get("total", 0), 1)
+        out: Dict[str, object] = {
+            "blocks_total": stats.get("total", 0),
+            "blocks_free": stats.get("free", 0),
+            "blocks_used": stats.get("used", 0),
+            "blocks_cached": stats.get("cached", 0),
+            "blocks_free_frac": round(
+                stats.get("free", 0) / total, 4
+            ),
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+        }
+        for name, depth in (
+            engine.scheduler.queue_depth_by_class().items()
+        ):
+            out[f"queue_depth.{name}"] = depth
+        return out
+
+    return fn
+
+
 def fault_source(history: FaultHistory) -> Callable[[], Dict[str, object]]:
     """Failure count + observed MTBF (omitted until measurable)."""
 

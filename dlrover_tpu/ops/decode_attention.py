@@ -22,8 +22,13 @@ bandwidth proportional to the raggedness, while this grid clamps each
 slot to its own fill.
 
 Parity note: the reference delegates decode to vLLM/torch kernels
-(paged attention); this is the TPU-native analogue for this repo's
-single-slab cache.
+(paged attention); :func:`decode_attention` is the TPU-native analogue
+for this repo's single-slab cache, and :func:`paged_decode_attention`
+is the block-table generalization for the paged KV pool
+(serving/kvpool): the per-row block table rides as a SECOND
+scalar-prefetch operand and the kv index map dereferences it, so grid
+step ``j`` of row ``ib`` DMAs pool block ``table[ib, j]`` — gather
+through the table with zero extra HBM traffic for the indirection.
 """
 
 import functools
@@ -86,6 +91,99 @@ def _kernel(
         o_ref[0, 0] = (
             acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+
+
+def _paged_kernel(
+    len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_k: int, scale: float,
+):
+    # The block table is consumed entirely by the kv index maps; the
+    # compute body is the flat kernel's online-softmax sweep unchanged.
+    del bt_ref
+    _kernel(
+        len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+        block_k=block_k, scale=scale,
+    )
+
+
+def paged_decode_attention(
+    q,             # [b, n_heads, d] — ONE query token per sequence
+    k_pool,        # [num_blocks, block_size, kv_heads, d]
+    v_pool,
+    block_tables,  # [b, max_blocks] int32 — pool rows per sequence
+    length,        # [b] int32 — filled LOGICAL rows per sequence
+    interpret=None,
+):
+    """Single-query attention straight through a block table.
+
+    The paged generalization of :func:`decode_attention`: the KV pool
+    is block-granular (``[num_blocks, block_size, kh, d]``) and each
+    sequence's logical cache is the concatenation of the pool rows its
+    ``block_tables`` row names. Both the fill vector AND the tables
+    ride as scalar-prefetch operands, so the kv index map dereferences
+    the table on the host side of the DMA: grid step ``j`` of row
+    ``ib`` copies pool block ``block_tables[ib, j]``, clamped past the
+    fill to the row's last valid table entry (repeat index = skipped
+    copy, the same Mosaic trick as the flat kernel). Visibility is the
+    engine invariant — a logical row is read iff ``< length[ib]`` —
+    so stale ids beyond the fill in a table row are never dereferenced
+    into the softmax. Returns ``[b, n_heads, d]``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    nb_pool, block_size, kh, _ = k_pool.shape
+    _, max_blocks = block_tables.shape
+    if h % kh:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kh}")
+    g = h // kh
+    gp = max(g, 8)  # sublane minimum
+    scale = d ** -0.5
+    qg = q.reshape(b, kh, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    length = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (b,)
+    )
+    tables = jnp.asarray(block_tables, jnp.int32)
+
+    def kv_index(ib, ih, j, len_ref, bt_ref):
+        # Clamp to the row's last FILLED logical block, then map the
+        # logical block through the row's table to a pool row.
+        last = jnp.maximum((len_ref[ib] - 1) // block_size, 0)
+        return (bt_ref[ib, jnp.minimum(j, last)], 0, ih)
+
+    kf = k_pool.reshape(nb_pool, block_size, kh * d)
+    vf = v_pool.reshape(nb_pool, block_size, kh * d)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, block_k=block_size, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kh, max_blocks),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, gp, d),
+                    lambda ib, ih, j, ln, bt: (ib, ih, 0, 0),
+                ),
+                pl.BlockSpec((1, block_size, d), kv_index),
+                pl.BlockSpec((1, block_size, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, d),
+                lambda ib, ih, j, ln, bt: (ib, ih, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), q.dtype),
+        interpret=interpret,
+    )(length, tables, qg, kf, vf)
+    return out[:, :, :g, :].reshape(b, h, d)
 
 
 def decode_attention(

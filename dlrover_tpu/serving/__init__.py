@@ -1,15 +1,20 @@
 """Continuous-batching serving engine (slot-pooled KV cache, ragged
 per-slot decode, iteration-level scheduling). See engine.py for the
-design and docs/DESIGN.md §25 for the invariants."""
+design and docs/DESIGN.md §25 for the invariants; serving/kvpool (§31)
+is the paged block-table variant with cross-request prefix reuse."""
 
 from dlrover_tpu.serving.engine import ServingEngine
 from dlrover_tpu.serving.scheduler import (
     DECODE,
+    DEFAULT_SLO_CLASSES,
     DONE,
+    FLEET_SLO_CLASSES,
     PREFILL,
     QUEUED,
     Request,
     Scheduler,
+    SloClass,
+    parse_slo_classes,
 )
 from dlrover_tpu.serving.metrics import serving_metrics
 
@@ -17,6 +22,10 @@ __all__ = [
     "ServingEngine",
     "Scheduler",
     "Request",
+    "SloClass",
+    "DEFAULT_SLO_CLASSES",
+    "FLEET_SLO_CLASSES",
+    "parse_slo_classes",
     "QUEUED",
     "PREFILL",
     "DECODE",

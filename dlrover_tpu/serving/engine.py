@@ -196,6 +196,7 @@ class ServingEngine:
         rng: Optional[jax.Array] = None,
         registry=None,
         max_requeues: int = 3,
+        slo_classes=None,
     ):
         if config.pp_stages > 1:
             raise NotImplementedError(
@@ -226,7 +227,8 @@ class ServingEngine:
         # livelock the serve loop re-queueing the same work forever.
         self.max_requeues = max_requeues
         self.scheduler = Scheduler(
-            slots, max_len, prefill_chunk, token_budget, drain_mode
+            slots, max_len, prefill_chunk, token_budget, drain_mode,
+            slo_classes=slo_classes,
         )
         self.metrics = serving_metrics(registry)
         self.metrics.slots_total.set(slots)
@@ -263,9 +265,11 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
                deadline_s: Optional[float] = None,
-               trace: Optional[dict] = None) -> Request:
+               trace: Optional[dict] = None,
+               slo_class: Optional[str] = None) -> Request:
         req = self.scheduler.submit(
-            prompt, max_new_tokens, temperature, deadline_s=deadline_s
+            prompt, max_new_tokens, temperature, deadline_s=deadline_s,
+            slo_class=slo_class,
         )
         # Upstream trace carrier (fleet attempt span): stored as a
         # plain dict; the phase spans are emitted retrospectively at
@@ -283,7 +287,10 @@ class ServingEngine:
                 self.scheduler.queue.remove(req)
             except ValueError:
                 pass
+        slot = req.slot
         self.scheduler.evict(req)
+        if slot >= 0:
+            self._release_slot(req, slot)
         self.metrics.requests.inc(outcome="cancelled")
         self.metrics.annotate("serving_evict", rid=req.rid)
 
@@ -324,20 +331,9 @@ class ServingEngine:
         for req in sch.shed_expired(t0):
             # Past-deadline queued work is an explicit terminal outcome,
             # surfaced through step()'s return like any completion.
-            finished.append(req)
-            self.metrics.shed.inc(reason="deadline")
-            self.metrics.requests.inc(outcome="shed")
-            self.metrics.failures.inc(reason="deadline")
-            self.metrics.annotate(
-                "serving_shed", rid=req.rid, reason="deadline"
-            )
-            self._emit_request_spans(req, status="error")
-        for req in sch.admit():
-            # A recycled slot starts from fill 0: stale KV above the
-            # cursor is invisible and rewritten before visibility.
-            self._lengths[req.slot] = 0
-            self._tokens[req.slot] = 0
-            self._temps[req.slot] = req.temperature
+            self._report_shed(req, finished)
+        for req in sch.admit(t0):
+            self._admit_slot(req)
             if req.requeues == 0:
                 # Re-admission after a step-error requeue is not a new
                 # request: counting it again would skew done/admitted
@@ -347,6 +343,10 @@ class ServingEngine:
                 "serving_admit", rid=req.rid, slot=req.slot,
                 prompt_len=req.prompt_len, requeues=req.requeues,
             )
+        for req in sch.drain_admission_shed():
+            # Deadline lapsed while waiting for a free slot: shed at
+            # the admission decision, same terminal surface.
+            self._report_shed(req, finished)
         try:
             fault_point("serving.step.error", step_idx=self._step_idx)
             pf = sch.pick_prefill()
@@ -361,7 +361,10 @@ class ServingEngine:
         self._step_idx += 1
         self.metrics.iterations.inc()
         self.metrics.queue_depth.set(len(sch.queue))
+        for name, depth in sch.queue_depth_by_class().items():
+            self.metrics.class_queue_depth.set(depth, slo_class=name)
         self.metrics.active_slots.set(len(sch.active()))
+        self._sync_pool_metrics()
         self._sync_retrace_metric()
         if decoding:
             dt = time.monotonic() - t0
@@ -380,6 +383,41 @@ class ServingEngine:
             f"engine did not drain within {max_iters} iterations"
         )
 
+    # ---- pool hooks (overridden by the paged engine, serving/kvpool) -------
+
+    def _admit_slot(self, req: Request) -> None:
+        """Bind engine-side per-slot state for a freshly admitted
+        request. A recycled slot starts from fill 0: stale KV above the
+        cursor is invisible and rewritten before visibility."""
+        self._lengths[req.slot] = 0
+        self._tokens[req.slot] = 0
+        self._temps[req.slot] = req.temperature
+
+    def _release_slot(self, req: Request, slot: int) -> None:
+        """A request left its slot (finish/cancel). The flat pool has
+        nothing to reclaim — stale rows are invisible; the paged engine
+        returns the slot's blocks to the allocator here."""
+
+    def _reset_pool(self) -> None:
+        """Rebuild ALL device-side cache state after a failed step call
+        (donated buffers may be invalidated)."""
+        self._k, self._v = self._fresh_pool()
+
+    def _sync_pool_metrics(self) -> None:
+        """Per-iteration pool gauges; the flat pool has none beyond the
+        slot gauges step() already sets."""
+
+    def _report_shed(self, req: Request, finished: List[Request]) -> None:
+        finished.append(req)
+        self.metrics.shed.inc(reason="deadline", slo_class=req.slo_class)
+        self.metrics.requests.inc(outcome="shed")
+        self.metrics.failures.inc(reason="deadline")
+        self.metrics.annotate(
+            "serving_shed", rid=req.rid, reason="deadline",
+            slo_class=req.slo_class,
+        )
+        self._emit_request_spans(req, status="error")
+
     # ---- internals ---------------------------------------------------------
 
     def _recover_from_step_error(self, err: BaseException,
@@ -394,7 +432,7 @@ class ServingEngine:
         persistent error cannot livelock the serve loop. Failed
         requests surface through ``finished`` with ``failed=True``."""
         requeued = self.scheduler.requeue_active()
-        self._k, self._v = self._fresh_pool()
+        self._reset_pool()
         self._lengths[:] = 0
         self._tokens[:] = 0
         self._temps[:] = 0.0
@@ -486,6 +524,8 @@ class ServingEngine:
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
         self.scheduler.finish(req)
+        if slot >= 0:
+            self._release_slot(req, slot)
         finished.append(req)
         self.metrics.requests.inc(
             outcome="truncated" if req.truncated else "finished"
@@ -519,6 +559,8 @@ class ServingEngine:
                 "truncated": req.truncated,
                 "requeues": req.requeues,
                 "failure_reason": req.failure_reason,
+                "slo_class": req.slo_class,
+                "prefix_hit_blocks": req.prefix_hit_blocks,
             },
             status=status,
         )

@@ -88,6 +88,12 @@ class FleetMetrics:
             "fleet_replica_restarts_total",
             "replica process/thread restarts issued by the router",
         )
+        self.affinity_dispatches = reg.counter(
+            "fleet_affinity_dispatches_total",
+            "dispatches routed by prefix affinity (§31): the request "
+            "went to the replica holding its prompt prefix's warm KV "
+            "blocks instead of the least-loaded choice",
+        )
         self.queue_depth = reg.gauge(
             "fleet_queue_depth",
             "router requests waiting for a dispatchable replica",

@@ -64,6 +64,9 @@ class WorkItem:
     max_new_tokens: int
     temperature: float = 0.0
     deadline_s: Optional[float] = None
+    # Named SLO class (§31): forwarded into the engine scheduler's
+    # weighted-fair admission; None = the engine's default class.
+    slo_class: Optional[str] = None
     # Trace carrier of the router's attempt span ({"trace_id",
     # "span_id"} or None): the replica engine parents its phase spans
     # to it, so a rerouted request is one tree across processes.
@@ -78,6 +81,7 @@ class WorkItem:
             "max_new_tokens": self.max_new_tokens,
             "temperature": self.temperature,
             "deadline_s": self.deadline_s,
+            "slo_class": self.slo_class,
             "trace": self.trace,
         }
 
@@ -105,16 +109,17 @@ def _completion(item_key, ok, tokens, truncated, failure_reason,
 
 def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
                  max_new_tokens, temperature, deadline_s,
-                 trace=None) -> None:
+                 trace=None, slo_class=None) -> None:
     """One work item into the engine — shared by both replica modes so
     the wire behavior cannot drift. A scheduler rejection (prompt too
-    long, bad deadline) is an EXPLICIT failed completion, never a crash:
-    crashing here would cascade the poison request through the fleet."""
+    long, bad deadline, unknown SLO class) is an EXPLICIT failed
+    completion, never a crash: crashing here would cascade the poison
+    request through the fleet."""
     try:
         req = engine.submit(
             prompt, max_new_tokens,
             temperature=temperature, deadline_s=deadline_s,
-            trace=trace,
+            trace=trace, slo_class=slo_class,
         )
     except Exception:  # noqa: BLE001 — any rejection is the same event
         emit(_completion(
@@ -291,6 +296,7 @@ class ThreadReplica:
                     item.request_id, item.attempt, item.prompt,
                     item.max_new_tokens, item.temperature,
                     item.deadline_s, trace=item.trace,
+                    slo_class=item.slo_class,
                 )
                 moved = True
             if engine.pending():
@@ -318,6 +324,9 @@ class SubprocessReplica:
         step_delay_ms: float = 0.0,
         schedule_path="",
         clock: Callable[[], float] = time.monotonic,
+        paged: bool = False,
+        block_size: int = 8,
+        num_blocks: Optional[int] = None,
     ):
         # ``schedule_path``: a str arms the same fault schedule on every
         # generation; a sequence indexes by generation ("" past the end)
@@ -333,6 +342,17 @@ class SubprocessReplica:
         self._step_delay_ms = step_delay_ms
         self._schedule_path = schedule_path
         self._clock = clock
+        self._paged = paged
+        self._block_size = block_size
+        self._num_blocks = num_blocks
+        # Latest paged-KV allocator stats the worker piggybacked on a
+        # heartbeat ({} until the first one); survives the process so
+        # the chaos episode can assert block conservation even after a
+        # SIGKILL. ``kv_violation`` records the first heartbeat whose
+        # stats broke conservation (checked at receipt — a violation
+        # mid-run must not be masked by a clean final state).
+        self.last_kv: Dict = {}
+        self.kv_violation: Optional[str] = None
         self._proc: Optional[subprocess.Popen] = None
         self._reader: Optional[threading.Thread] = None
         self._outbox: Deque[dict] = deque()
@@ -395,6 +415,10 @@ class SubprocessReplica:
             "--heartbeat-s", str(self._heartbeat_s),
             "--step-delay-ms", str(self._step_delay_ms),
         ]
+        if self._paged:
+            args += ["--paged", "--block-size", str(self._block_size)]
+            if self._num_blocks is not None:
+                args += ["--num-blocks", str(self._num_blocks)]
         log_path = os.path.join(
             self._work_dir,
             f"replica{self.replica_id}_gen{self.generation}.log",
@@ -499,6 +523,10 @@ class SubprocessReplica:
                 kind = event.get("kind")
                 if kind == "heartbeat":
                     self._hb = self._clock()
+                    kv = event.get("kv")
+                    if kv:
+                        self.last_kv = kv
+                        self._check_kv(kv)
                 elif kind == "ready":
                     self._hb = self._clock()
                     self._ready.set()
@@ -508,3 +536,29 @@ class SubprocessReplica:
                     self._outbox.append(event)
         except (OSError, ValueError):
             pass
+
+    def _check_kv(self, kv: dict) -> None:
+        """Block conservation, checked at heartbeat RECEIPT: free +
+        used + cached must sum to the managed pool and no refcount may
+        go negative. The first violation is pinned — the chaos
+        episode's block-reclaim invariant reads it after the drain."""
+        if self.kv_violation is not None:
+            return
+        try:
+            total = kv["free"] + kv["used"] + kv["cached"]
+            if total != kv["total"]:
+                self.kv_violation = (
+                    f"replica {self.replica_id}: free {kv['free']} + "
+                    f"used {kv['used']} + cached {kv['cached']} = "
+                    f"{total} != total {kv['total']}"
+                )
+            elif kv.get("negative_refs", 0):
+                self.kv_violation = (
+                    f"replica {self.replica_id}: "
+                    f"{kv['negative_refs']} negative refcount(s)"
+                )
+        except (KeyError, TypeError) as e:
+            self.kv_violation = (
+                f"replica {self.replica_id}: malformed kv stats "
+                f"{kv!r}: {e}"
+            )

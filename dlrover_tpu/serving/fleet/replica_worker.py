@@ -48,6 +48,11 @@ def _read_commands(q: "queue.Queue[dict]") -> None:
 
 
 def main(argv=None) -> int:
+    from dlrover_tpu.serving.scheduler import (
+        FLEET_SLO_CLASSES,
+        parse_slo_classes,
+    )
+
     parser = argparse.ArgumentParser(description="fleet replica worker")
     parser.add_argument("--replica-id", default="0")
     parser.add_argument("--slots", type=int, default=2)
@@ -60,6 +65,29 @@ def main(argv=None) -> int:
         "(the soak-worker --step-ms idiom): sleeping releases the "
         "host CPU, so a fleet bench on a small host measures the "
         "router/host plane, not the tiny model's CPU decode",
+    )
+    parser.add_argument(
+        "--paged", action="store_true",
+        help="serve from the paged block-table engine "
+        "(serving/kvpool) instead of the flat slot pool; heartbeats "
+        "then carry allocator stats for the block-reclaim invariant",
+    )
+    parser.add_argument("--block-size", type=int, default=8)
+    parser.add_argument(
+        "--num-blocks", type=int, default=0,
+        help="managed pool size (0 = flat-equivalent: "
+        "slots*max_len/block_size + sentinel)",
+    )
+    parser.add_argument(
+        "--slo-classes",
+        default=",".join(
+            f"{c.name}:{c.weight:g}" for c in FLEET_SLO_CLASSES
+        ),
+        help='SLO classes as "name:weight,..."; first is the default '
+        "for untagged requests. The default is scheduler."
+        "FLEET_SLO_CLASSES — a stock replica understands the "
+        "conventional interactive/batch split so tagged fleet "
+        "traffic is never rejected at the scheduler",
     )
     args = parser.parse_args(argv)
 
@@ -79,12 +107,27 @@ def main(argv=None) -> int:
 
     cfg = llama.tiny_config()
     params, _ = llama.init_params(cfg, jax.random.key(0))
-    engine = ServingEngine(
-        cfg, params,
-        slots=args.slots,
-        max_len=args.max_len,
-        prefill_chunk=args.prefill_chunk,
-    )
+    slo_classes = parse_slo_classes(args.slo_classes)
+    if args.paged:
+        from dlrover_tpu.serving.kvpool import PagedServingEngine
+
+        engine = PagedServingEngine(
+            cfg, params,
+            slots=args.slots,
+            max_len=args.max_len,
+            prefill_chunk=args.prefill_chunk,
+            block_size=args.block_size,
+            num_blocks=args.num_blocks or None,
+            slo_classes=slo_classes,
+        )
+    else:
+        engine = ServingEngine(
+            cfg, params,
+            slots=args.slots,
+            max_len=args.max_len,
+            prefill_chunk=args.prefill_chunk,
+            slo_classes=slo_classes,
+        )
     engine.warmup()
 
     commands: "queue.Queue[dict]" = queue.Queue()
@@ -104,7 +147,14 @@ def main(argv=None) -> int:
                 fault_point(
                     "fleet.health.heartbeat", replica=args.replica_id
                 )
-                _emit({"kind": "heartbeat", "replica": args.replica_id})
+                beat = {"kind": "heartbeat", "replica": args.replica_id}
+                if args.paged:
+                    # Allocator accounting rides every beat so block
+                    # conservation is checkable THROUGH a crash: the
+                    # parent validates at receipt, and a SIGKILLed
+                    # replica's last-known stats survive it.
+                    beat["kv"] = engine.kv_stats()
+                _emit(beat)
                 last_hb = now
             except Exception:
                 last_hb = now  # dropped beat; try again next window
@@ -124,6 +174,7 @@ def main(argv=None) -> int:
                     cmd["prompt"], cmd["max_new_tokens"],
                     cmd.get("temperature", 0.0), cmd.get("deadline_s"),
                     trace=cmd.get("trace"),
+                    slo_class=cmd.get("slo_class"),
                 )
         if engine.pending():
             # The chaos episode's SIGKILL-mid-decode lands here: a

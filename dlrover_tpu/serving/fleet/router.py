@@ -52,7 +52,7 @@ sleeps.
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
@@ -88,6 +88,23 @@ class RouterConfig:
     # slow boot becomes an infinite restart loop.
     restart_boot_grace_s: float = 30.0
     seed: int = 0
+    # Prefix-affinity dispatch (§31): requests whose leading tokens
+    # match a recently dispatched prefix prefer the replica holding
+    # the warm KV blocks — health gating and at-most-once semantics
+    # UNCHANGED (affinity only picks among already-dispatchable
+    # candidates, after probe canarying, and never a replica the
+    # request already tried).
+    prefix_affinity: bool = True
+    # Leading tokens hashed as the affinity key: requests sharing at
+    # LEAST this many leading tokens route together. Two cache blocks
+    # at the default block size — shorter than typical system prompts
+    # (keying on more tokens than the shared prefix would fold the
+    # divergent tail into the hash and group nothing).
+    affinity_prefix_tokens: int = 16
+    affinity_max_entries: int = 2048    # bounded LRU prefix -> replica map
+    # Affinity yields to load balance when the warm replica is this
+    # many in-flight items busier than the least-loaded candidate.
+    affinity_max_load_gap: int = 4
     health: health_lib.HealthPolicy = field(
         default_factory=health_lib.HealthPolicy
     )
@@ -118,6 +135,8 @@ class FleetRequest:
     prompt: List[int]
     max_new_tokens: int
     temperature: float = 0.0
+    slo_class: Optional[str] = None       # named SLO class (§31)
+    prefix_key: Optional[int] = None      # affinity hash of the prompt head
     deadline: Optional[float] = None      # absolute, router clock
     submit_t: float = 0.0
     accepted: bool = True
@@ -193,6 +212,9 @@ class FleetRouter:
         # request that went terminal" contract holds.
         self._orphan_done: List[FleetRequest] = []
         self._live_accepted = 0   # accepted, no terminal result yet
+        # prefix hash -> replica_id holding that prefix's warm blocks
+        # (bounded LRU; entries for gone replicas lapse on validation).
+        self._affinity: "OrderedDict[int, str]" = OrderedDict()
         self._last_restart: Dict[str, float] = {}
         self._service_lat: Deque[float] = deque(maxlen=256)
         self._rng = random.Random(self.config.seed)
@@ -328,11 +350,12 @@ class FleetRouter:
         temperature: float = 0.0,
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        slo_class: Optional[str] = None,
     ) -> FleetRequest:
         with self._lock:
             return self._submit_locked(
                 prompt, max_new_tokens, temperature, deadline_s,
-                request_id,
+                request_id, slo_class,
             )
 
     def _submit_locked(
@@ -342,6 +365,7 @@ class FleetRouter:
         temperature: float,
         deadline_s: Optional[float],
         request_id: Optional[str],
+        slo_class: Optional[str] = None,
     ) -> FleetRequest:
         now = self._clock()
         self._seq += 1
@@ -356,12 +380,19 @@ class FleetRouter:
             # mean "no deadline" — that is the opposite of the intent.
             raise ValueError("deadline_s must be positive")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        prefix_key = None
+        if self.config.prefix_affinity:
+            prefix_key = hash(
+                tuple(prompt[:self.config.affinity_prefix_tokens])
+            )
         req = FleetRequest(
             request_id=request_id,
             seq=self._seq,
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
+            slo_class=slo_class,
+            prefix_key=prefix_key,
             deadline=(
                 now + deadline_s if deadline_s is not None else None
             ),
@@ -833,6 +864,44 @@ class FleetRouter:
                 return rid
         return None
 
+    def _pick_affinity_replica(self, req: FleetRequest,
+                               now: float) -> Optional[str]:
+        """The replica that last served this prompt prefix, if it is
+        still a LEGITIMATE candidate: alive, ready, breaker-admitted,
+        untried by this request, and not more than
+        ``affinity_max_load_gap`` in-flight items busier than the
+        least-loaded dispatchable peer. Health gating is unchanged —
+        affinity only biases the choice among admitted replicas."""
+        key = req.prefix_key
+        if key is None:
+            return None
+        rid = self._affinity.get(key)
+        if rid is None:
+            return None
+        replica = self._replicas.get(rid)
+        if replica is None:
+            self._affinity.pop(key, None)   # drained/removed replica
+            return None
+        if (
+            rid in req.tried_replicas
+            or not replica.alive()
+            or not replica.wait_ready(0.0)
+            or not self._health[rid].dispatchable(now)
+            or self._health[rid].state == health_lib.BROKEN
+        ):
+            return None
+        loads = [
+            len(self._ledger[r]) for r in self._replicas
+            if self._replicas[r].alive()
+            and self._health[r].state != health_lib.BROKEN
+        ]
+        if loads and (
+            len(self._ledger[rid]) - min(loads)
+            > self.config.affinity_max_load_gap
+        ):
+            return None   # warm blocks are not worth a hot spot
+        return rid
+
     def _dispatch_queued(self, now: float,
                          newly_done: List[FleetRequest]):
         stalled: List[FleetRequest] = []
@@ -846,6 +915,10 @@ class FleetRouter:
                 # a retried request has already paid a failed attempt
                 # and goes to the best-known replica.
                 rid = self._pick_probe_replica(now)
+            affine = False
+            if rid is None and self.config.prefix_affinity:
+                rid = self._pick_affinity_replica(req, now)
+                affine = rid is not None
             if rid is None:
                 rid = self._pick_replica(
                     now, exclude=req.tried_replicas
@@ -854,7 +927,8 @@ class FleetRouter:
                 stalled.append(req)
                 break
             kind = "retry" if req.failed_attempts else "primary"
-            self._dispatch(req, rid, kind, now, newly_done)
+            if self._dispatch(req, rid, kind, now, newly_done) and affine:
+                self.metrics.affinity_dispatches.inc()
         # Preserve order for everything not dispatched this pump.
         for req in reversed(stalled):
             self._queue.appendleft(req)
@@ -882,6 +956,7 @@ class FleetRouter:
             max_new_tokens=req.max_new_tokens,
             temperature=req.temperature,
             deadline_s=deadline_s,
+            slo_class=req.slo_class,
             trace=aspan.carrier() if aspan is not None else None,
         )
         try:
@@ -911,6 +986,13 @@ class FleetRouter:
             return False
         req.attempt_seq += 1
         req.tried_replicas.add(rid)
+        if req.prefix_key is not None:
+            # This replica now holds (or is prefilling) the prefix's
+            # blocks: later same-prefix requests prefer it.
+            self._affinity[req.prefix_key] = rid
+            self._affinity.move_to_end(req.prefix_key)
+            while len(self._affinity) > self.config.affinity_max_entries:
+                self._affinity.popitem(last=False)
         if aspan is not None:
             req.attempt_spans[attempt] = aspan
         if req.first_dispatch_t is None:

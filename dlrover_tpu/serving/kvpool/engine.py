@@ -1,0 +1,656 @@
+"""Paged serving engine: block-table KV over a shared block pool.
+
+The flat engine (serving/engine.py) reserves one ``[max_len]`` KV row
+per slot — a 20-token request pins as much HBM as a 1024-token one, and
+a shared system prompt re-prefills from scratch in every slot. Here the
+cache is ``[layers, num_blocks, block_size, kv_heads, head_dim]`` and a
+slot's logical cache is the pool rows its BLOCK TABLE names:
+
+- **Block tables as traced args.** The ``[slots, max_blocks]`` int32
+  tables ride into the compiled steps exactly like the fill vector:
+  every admission/allocation/COW changes table VALUES, never shapes, so
+  the no-retrace-across-admissions property survives paging. Inside
+  the decode step each layer gathers its per-slot logical view through
+  the table and runs the SAME append-free ragged attention as the flat
+  engine (``models/generate._layer_decode_read_only``) — token-exact by
+  construction. The append is a per-slot scatter at ``(table[cursor //
+  bs], cursor % bs)``; non-active slots are redirected to the reserved
+  SENTINEL block 0 so their masked-garbage writes can never land in a
+  block another slot shares (the flat engine's own-row trick does not
+  survive sharing). Note on the hot path: the XLA gather reads the
+  same ``[slots, max_len]`` logical view per layer the FLAT engine's
+  append-free step already reads — paging's win here is CAPACITY
+  (blocks per admitted token), not per-step bandwidth. The
+  length-clamped Pallas variant (``ops.decode_attention.
+  paged_decode_attention``, parity-tested) is the TPU-targeted
+  alternative, deliberately not the default for the same measured
+  reason as the flat engine's (§21): the per-(batch, kv-head) grid
+  serializes on TPU and loses to the XLA step at serving shapes.
+- **Visibility invariant, unchanged.** A logical row is read iff
+  ``row < fill``; stale or foreign content beyond a slot's fill —
+  including the longer tail of a SHARED prefix block — is masked out
+  per slot, per row (docs/DESIGN.md §31).
+- **Cross-request prefix cache.** Admission hashes the prompt's full
+  blocks against the :class:`PrefixCache`; a hit slots the warm chain
+  straight into the block table and prefill SKIPS the covered chunks
+  (TTFT drops by the skipped chunk iterations). Shared blocks are
+  refcounted and immutable: the one legal rewrite (a chunk-aligned
+  re-prefill over a shared block, identical values) privatizes first
+  via copy-on-write — a small compiled block-copy program, counted in
+  ``trace_counts`` like its siblings.
+- **Oversubscription + relief.** ``num_blocks`` may be far below
+  ``slots * max_blocks`` (short requests hold few blocks — that is the
+  capacity win). When the pool runs dry the engine first evicts
+  prefix-cache LRU chains, then PREEMPTS the youngest active request
+  (front-requeued with progress reset, no requeue-budget charge); an
+  engine is constructed with room for at least one full-length slot,
+  so relief always terminates.
+"""
+
+import functools
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.models import generate as gen_lib
+from dlrover_tpu.models import llama
+from dlrover_tpu.serving.engine import ServingEngine
+from dlrover_tpu.serving.kvpool.allocator import (
+    BlockAllocator,
+    BlockPoolExhausted,
+)
+from dlrover_tpu.serving.kvpool.prefix_cache import PrefixCache
+from dlrover_tpu.serving.scheduler import DECODE, PREFILL, Request
+
+# Pool row 0 absorbs the masked-garbage appends of non-active slots;
+# never allocated, never read.
+SENTINEL_BLOCK = 0
+
+
+class _PagedSteps(NamedTuple):
+    prefill: object
+    decode: object
+    cow: object
+    trace_counts: Dict[str, int]
+
+
+def _build_paged_decode(config, slots: int, max_blocks: int,
+                        block_size: int, counts):
+    """[slots] tokens -> one decoded token per slot, ragged lengths,
+    cache gathered per layer through the block tables."""
+    max_len = max_blocks * block_size
+    kh, hd = config.n_kv_heads, config.head_dim
+
+    def step(k, v, params, tables, lengths, tokens, active, temps,
+             rng, step_idx):
+        counts["decode"] += 1  # traces only
+        positions = lengths[:, None]                     # [slots, 1]
+        x = llama.embed_tokens(config, params, tokens[:, None])
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in                      # [nb, bs, kh, hd]
+            k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+            v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+            y, k_new, v_new = gen_lib._layer_decode_read_only(
+                config, pl, carry, positions, k_view, v_view, lengths
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v)
+        )
+        # Per-slot append through the table. Non-active slots are
+        # redirected to the sentinel block: their garbage must never
+        # land in a block another slot may SHARE (the flat engine's
+        # own-row invisibility does not survive sharing). Active slots
+        # write their privately-owned cursor block (host COW-ensured).
+        write = jnp.minimum(lengths, max_len - 1)
+        blk = jnp.take_along_axis(
+            tables, (write // block_size)[:, None], axis=1
+        )[:, 0]
+        blk = jnp.where(active, blk, SENTINEL_BLOCK)
+        off = jnp.where(active, write % block_size, 0)
+        k = k.at[:, blk, off].set(k_news[:, :, 0].astype(k.dtype))
+        v = v.at[:, blk, off].set(v_news[:, :, 0].astype(v.dtype))
+        logits = llama.unembed(config, params, x)[:, 0]   # [slots, V]
+        sub = jax.random.fold_in(rng, step_idx * 2)
+        nxt = gen_lib.sample_token(logits, sub, temps)
+        nxt = jnp.where(active, nxt, tokens)
+        return k, v, nxt
+
+    return step
+
+
+def _build_paged_prefill(config, max_blocks: int, block_size: int,
+                         chunk: int, counts):
+    """One prompt chunk into ONE slot's blocks: gather the slot's
+    logical cache through its table row, run the flat prefill body,
+    scatter back only the touched blocks (shared untouched blocks are
+    never rewritten — the COW invariant)."""
+    L = config.n_layers
+    kh, hd = config.n_kv_heads, config.head_dim
+    max_len = max_blocks * block_size
+    # Blocks a chunk can touch: chunk//bs full blocks when chunks are
+    # block-multiples, else the single block containing the chunk
+    # (init enforces one of chunk % bs == 0 / bs % chunk == 0).
+    n_touch = max(chunk // block_size, 1)
+
+    def prefill(k, v, params, tokens, table_row, start, n_valid, temp,
+                rng, step_idx):
+        counts["prefill"] += 1  # traces only
+        k_slot = k[:, table_row].reshape(L, 1, max_len, kh, hd)
+        v_slot = v[:, table_row].reshape(L, 1, max_len, kh, hd)
+        positions = (
+            start + jnp.arange(chunk, dtype=jnp.int32)
+        )[None, :]
+        x = llama.embed_tokens(config, params, tokens)
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_c, v_c = gen_lib._layer_decode(
+                config, pl, carry, positions, k_c, v_c, start,
+                attn_impl="xla",
+            )
+            return y, (k_c, v_c)
+
+        x, (k_slot, v_slot) = jax.lax.scan(
+            body, x, (params["layers"], k_slot, v_slot)
+        )
+        # Scatter back ONLY the touched blocks. touched0*bs <= start
+        # and the touched span covers [start, start+chunk) exactly
+        # (chunk-aligned starts; see the divisibility contract), so
+        # shared UNtouched blocks are never rewritten.
+        touched0 = start // block_size
+        seg_k = jax.lax.dynamic_slice(
+            k_slot, (0, 0, touched0 * block_size, 0, 0),
+            (L, 1, n_touch * block_size, kh, hd),
+        ).reshape(L, n_touch, block_size, kh, hd)
+        seg_v = jax.lax.dynamic_slice(
+            v_slot, (0, 0, touched0 * block_size, 0, 0),
+            (L, 1, n_touch * block_size, kh, hd),
+        ).reshape(L, n_touch, block_size, kh, hd)
+        ids = jax.lax.dynamic_slice(table_row, (touched0,), (n_touch,))
+        k = k.at[:, ids].set(seg_k.astype(k.dtype))
+        v = v.at[:, ids].set(seg_v.astype(v.dtype))
+        h = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        logits = llama.unembed(config, params, h)[0, 0]    # [V]
+        sub = jax.random.fold_in(rng, step_idx * 2 + 1)
+        first = gen_lib.sample_token(logits, sub, temp)
+        return k, v, first
+
+    return prefill
+
+
+def _build_cow_copy(counts):
+    """Device block copy src -> dst (both K and V, all layers): the
+    copy-on-write primitive. src/dst are traced scalars — privatizing
+    any block never retraces."""
+
+    def cow(k, v, src, dst):
+        counts["cow"] += 1  # traces only
+        k = k.at[:, dst].set(k[:, src])
+        v = v.at[:, dst].set(v[:, src])
+        return k, v
+
+    return cow
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_steps(
+    config: llama.TpuLMConfig, slots: int, num_blocks: int,
+    max_blocks: int, block_size: int, chunk: int,
+) -> _PagedSteps:
+    """Compiled once per shape key, shared across engines (the flat
+    engine's lru_cache discipline). Pools donated; tables/lengths/ids
+    all plain traced arguments."""
+    counts = {"prefill": 0, "decode": 0, "cow": 0}
+    decode = jax.jit(
+        _build_paged_decode(config, slots, max_blocks, block_size,
+                            counts),
+        donate_argnums=(0, 1),
+    )
+    prefill = jax.jit(
+        _build_paged_prefill(config, max_blocks, block_size, chunk,
+                             counts),
+        donate_argnums=(0, 1),
+    )
+    cow = jax.jit(_build_cow_copy(counts), donate_argnums=(0, 1))
+    return _PagedSteps(prefill=prefill, decode=decode, cow=cow,
+                       trace_counts=counts)
+
+
+class PagedServingEngine(ServingEngine):
+    """ServingEngine over a paged block pool (see module docstring).
+
+    Same host-side step loop, scheduler, metrics, spans, and recovery
+    semantics as the flat engine — only the pool hooks and the two step
+    programs differ. ``num_blocks`` defaults to exactly the flat
+    engine's HBM budget (``slots * max_len / block_size`` + sentinel);
+    pass fewer blocks and MORE slots for the oversubscribed capacity
+    win the bench measures."""
+
+    def __init__(
+        self,
+        config: llama.TpuLMConfig,
+        params,
+        slots: int,
+        max_len: int,
+        prefill_chunk: int = 64,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefix_cache: bool = True,
+        prefix_cache_blocks: Optional[int] = None,
+        token_budget: Optional[int] = None,
+        drain_mode: bool = False,
+        rng=None,
+        registry=None,
+        max_requeues: int = 3,
+        slo_classes=None,
+    ):
+        if max_len % block_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_size "
+                f"{block_size}"
+            )
+        if prefill_chunk % block_size and block_size % prefill_chunk:
+            # Chunk/block alignment keeps the prefill scatter-back a
+            # STATIC number of whole blocks; misaligned chunks would
+            # straddle a shared/fresh block boundary mid-block.
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} and block_size "
+                f"{block_size} must divide one another"
+            )
+        self.block_size = block_size
+        self.max_blocks = max_len // block_size
+        if num_blocks is None:
+            num_blocks = slots * self.max_blocks + 1
+        if num_blocks - 1 < self.max_blocks:
+            # Room for at least one full-length slot, or pool-pressure
+            # relief (evict cache, preempt peers) could never free
+            # enough for a lone max-length request.
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one full slot "
+                f"({self.max_blocks} blocks + sentinel)"
+            )
+        self.num_blocks = num_blocks
+        self._allocator = BlockAllocator(num_blocks, reserved=1)
+        self._cache: Optional[PrefixCache] = (
+            PrefixCache(self._allocator, block_size,
+                        capacity_blocks=prefix_cache_blocks)
+            if prefix_cache else None
+        )
+        self._tables = np.zeros(
+            (slots, self.max_blocks), np.int32
+        )
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        # USABLE-hit accounting (what kv_stats/bench/heartbeats report):
+        # a raw cache hit whose blocks are all discarded by chunk
+        # alignment saved nothing and must count as a miss — the
+        # cache's own raw counters would overstate the win.
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_hit_blocks = 0
+        super().__init__(
+            config, params, slots, max_len,
+            prefill_chunk=prefill_chunk, token_budget=token_budget,
+            drain_mode=drain_mode, rng=rng, registry=registry,
+            max_requeues=max_requeues, slo_classes=slo_classes,
+        )
+        # Block watermark: only admit a request the pool can hold
+        # (prompt + first decode block) counting evictable cache as
+        # free — otherwise bursty arrivals thrash preemptions, each
+        # one burning its victim's whole prefill investment.
+        self.scheduler.admission_gate = self._can_admit
+        # The base __init__ bound the FLAT step programs (never traced
+        # — jit is lazy); swap in the paged programs, keyed on the
+        # paged shapes, and re-settle the retrace snapshot.
+        self._steps = _paged_steps(
+            config, slots, self.num_blocks, self.max_blocks,
+            block_size, prefill_chunk,
+        )
+        self._trace_snapshot = dict(self._steps.trace_counts)
+        # K+V bytes per block, for the HBM-in-use gauge.
+        self._block_bytes = int(
+            2 * config.n_layers * block_size * config.n_kv_heads
+            * config.head_dim * jnp.dtype(config.compute_dtype).itemsize
+        )
+        self.metrics.kv_blocks_total.set(self._allocator.managed)
+
+    # ---- pool construction / programs --------------------------------------
+
+    def _fresh_pool(self):
+        shape = (
+            self.config.n_layers, self.num_blocks, self.block_size,
+            self.config.n_kv_heads, self.config.head_dim,
+        )
+        dtype = self.config.compute_dtype
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def warmup(self) -> None:
+        """Compile all three paged programs on throwaway state, then
+        rebuild the pool — first real request pays no compile."""
+        chunk = np.zeros((1, self.prefill_chunk), np.int32)
+        k, v, first = self._steps.prefill(
+            self._k, self._v, self._params, jnp.asarray(chunk),
+            jnp.zeros(self.max_blocks, jnp.int32),
+            np.int32(0), np.int32(1), np.float32(0.0),
+            self._rng, np.int32(0),
+        )
+        k, v, nxt = self._steps.decode(
+            k, v, self._params,
+            jnp.asarray(np.zeros((self.slots, self.max_blocks),
+                                 np.int32)),
+            jnp.asarray(np.zeros(self.slots, np.int32)),
+            jnp.asarray(np.zeros(self.slots, np.int32)),
+            jnp.asarray(np.zeros(self.slots, bool)),
+            jnp.asarray(np.zeros(self.slots, np.float32)),
+            self._rng, np.int32(0),
+        )
+        k, v = self._steps.cow(k, v, np.int32(0), np.int32(0))
+        jax.block_until_ready(v)
+        del k, v
+        self._k, self._v = self._fresh_pool()
+        self._trace_snapshot = dict(self._steps.trace_counts)
+
+    # ---- block bookkeeping -------------------------------------------------
+
+    def _can_admit(self, req: Request) -> bool:
+        """Admission watermark: free + cache-evictable blocks must
+        cover the request's whole prompt plus one decode block (a
+        prefix hit only LOWERS the real need — conservative). A
+        request that already LOST its slot to pool pressure re-admits
+        pessimistically, against its full prompt+decode worst case:
+        optimistic re-admission is exactly the preempt-readmit-preempt
+        thrash cycle, each lap burning a whole prefill."""
+        rows = req.prompt_len + (
+            req.max_new_tokens if req.preemptions else 1
+        )
+        need = -(-min(rows, self.max_len) // self.block_size)
+        stats = self._allocator.stats(self._live_block_ids())
+        return stats["free"] + stats["cached"] >= need
+
+    def _live_block_ids(self) -> set:
+        live = set()
+        for blocks in self._slot_blocks:
+            live.update(blocks)
+        return live
+
+    def _alloc_blocks(self, n: int, requester: Request) -> List[int]:
+        """All-or-nothing allocation with the relief ladder: prefix
+        cache LRU eviction first, then preemption of the YOUNGEST
+        active request (never ``requester``). Raises only when relief
+        is structurally impossible (requester alone overflows the
+        pool), which the step-error recovery path bounds."""
+        while True:
+            try:
+                return self._allocator.alloc(n)
+            except BlockPoolExhausted:
+                missing = n - self._allocator.free_count()
+                if self._cache is not None and self._cache.evict_lru(
+                    missing
+                ):
+                    continue
+                victim = self._pick_preemption_victim(requester)
+                if victim is None:
+                    raise
+                self._preempt(victim)
+
+    def _pick_preemption_victim(
+        self, requester: Request
+    ) -> Optional[Request]:
+        cands = [
+            r for r in self.scheduler.active()
+            if r is not requester and r.state in (PREFILL, DECODE)
+            and self._slot_blocks[r.slot]
+        ]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.rid)  # youngest first out
+
+    def _preempt(self, victim: Request) -> None:
+        slot = victim.slot
+        self.scheduler.preempt(victim)
+        self._release_slot(victim, slot)
+        self._lengths[slot] = 0
+        self._tokens[slot] = 0
+        self._temps[slot] = 0.0
+        self.metrics.kv_preemptions.inc()
+        self.metrics.requests.inc(outcome="preempted")
+        self.metrics.annotate(
+            "serving_preempt", rid=victim.rid, slot=slot,
+        )
+        logger.info(
+            "kvpool pressure: preempted rid %d (slot %d) to free "
+            "blocks", victim.rid, slot,
+        )
+
+    def _ensure_blocks(self, req: Request, upto_rows: int) -> None:
+        """Grow ``req``'s block table to cover ``upto_rows`` logical
+        rows (clamped to max_len)."""
+        upto_rows = min(upto_rows, self.max_len)
+        need = -(-upto_rows // self.block_size)
+        blocks = self._slot_blocks[req.slot]
+        missing = need - len(blocks)
+        if missing <= 0:
+            return
+        fresh = self._alloc_blocks(missing, req)
+        start = len(blocks)
+        blocks.extend(fresh)
+        self._tables[req.slot, start:start + len(fresh)] = fresh
+
+    def _privatize(self, req: Request, logical_idx: int) -> None:
+        """COW: the slot is about to WRITE logical block
+        ``logical_idx``; if that block is shared, copy it to a fresh
+        private block first (shared blocks are immutable)."""
+        blocks = self._slot_blocks[req.slot]
+        old = blocks[logical_idx]
+        if self._allocator.refcount(old) <= 1:
+            return
+        new = self._alloc_blocks(1, req)[0]
+        self._k, self._v = self._steps.cow(
+            self._k, self._v, np.int32(old), np.int32(new)
+        )
+        self._allocator.decref(old)
+        self._allocator.cow_copies_total += 1
+        blocks[logical_idx] = new
+        self._tables[req.slot, logical_idx] = new
+        self.metrics.kv_cow_copies.inc()
+
+    # ---- pool hooks (the base step loop calls these) -----------------------
+
+    def _admit_slot(self, req: Request) -> None:
+        super()._admit_slot(req)
+        slot = req.slot
+        self._tables[slot, :] = SENTINEL_BLOCK
+        self._slot_blocks[slot] = []
+        if self._cache is None:
+            return
+        hit = self._cache.lookup(req.prompt)
+        # Never skip the FINAL prompt token: its forward produces the
+        # first sampled token, so a full-prompt hit still re-runs the
+        # last chunk (identical values; COW privatizes any shared
+        # touched block). Chunk-align the resume point, and drop hit
+        # blocks that lie ENTIRELY inside the re-prefilled span — they
+        # would only be COW-copied and rewritten.
+        start = 0
+        if hit:
+            start = min(len(hit) * self.block_size, req.prompt_len - 1)
+            start -= start % self.prefill_chunk
+            keep = -(-start // self.block_size)  # partial head stays
+            for block in hit[keep:]:
+                self._allocator.decref(block)
+            hit = hit[:keep]
+        if not hit:
+            self.metrics.prefix_lookups.inc(outcome="miss")
+            self._prefix_misses += 1
+            return
+        self.metrics.prefix_lookups.inc(outcome="hit")
+        self.metrics.prefix_hit_blocks.inc(len(hit))
+        self._prefix_hits += 1
+        self._prefix_hit_blocks += len(hit)
+        req.prefix_hit_blocks = len(hit)
+        self._slot_blocks[slot] = list(hit)
+        self._tables[slot, :len(hit)] = hit
+        req.prefill_pos = start
+        self._lengths[slot] = start
+        self.metrics.annotate(
+            "serving_prefix_hit", rid=req.rid, blocks=len(hit),
+            resumed_at=start,
+        )
+
+    def _release_slot(self, req: Request, slot: int) -> None:
+        for block in self._slot_blocks[slot]:
+            self._allocator.decref(block)
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = SENTINEL_BLOCK
+
+    def _reset_pool(self) -> None:
+        # A failed step may have invalidated the donated pools: the
+        # device blocks AND everything that points at them (allocator,
+        # prefix cache, tables) restart from scratch.
+        self._k, self._v = self._fresh_pool()
+        self._allocator = BlockAllocator(self.num_blocks, reserved=1)
+        if self._cache is not None:
+            self._cache = PrefixCache(
+                self._allocator, self.block_size,
+                capacity_blocks=self._cache.capacity_blocks,
+            )
+        self._tables[:, :] = SENTINEL_BLOCK
+        self._slot_blocks = [[] for _ in range(self.slots)]
+
+    def _sync_pool_metrics(self) -> None:
+        stats = self._allocator.stats(self._live_block_ids())
+        self.metrics.kv_blocks.set(stats["free"], state="free")
+        self.metrics.kv_blocks.set(stats["used"], state="used")
+        self.metrics.kv_blocks.set(stats["cached"], state="cached")
+        self.metrics.kv_bytes_in_use.set(
+            (stats["used"] + stats["cached"]) * self._block_bytes
+        )
+
+    # ---- step internals ----------------------------------------------------
+
+    def _run_prefill_chunk(self, req: Request, finished: List[Request]):
+        c = self.prefill_chunk
+        start = req.prefill_pos
+        n_valid = min(c, req.prompt_len - start)
+        self._ensure_blocks(req, start + n_valid)
+        # Privatize every block this chunk touches (a prefix-hit resume
+        # can chunk-align BELOW the shared span: the re-prefill writes
+        # identical values, but never into a shared block).
+        first_blk = start // self.block_size
+        last_blk = min(
+            (start + c - 1) // self.block_size,
+            len(self._slot_blocks[req.slot]) - 1,
+        )
+        for idx in range(first_blk, last_blk + 1):
+            self._privatize(req, idx)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, :n_valid] = req.prompt[start:start + n_valid]
+        self._k, self._v, first = self._steps.prefill(
+            self._k, self._v, self._params, jnp.asarray(chunk),
+            jnp.asarray(self._tables[req.slot]),
+            np.int32(start), np.int32(n_valid),
+            np.float32(req.temperature), self._rng,
+            np.int32(self._step_idx),
+        )
+        req.prefill_pos += n_valid
+        self._lengths[req.slot] = req.prefill_pos
+        self.metrics.tokens.inc(n_valid, kind="prefill")
+        if req.prefill_pos < req.prompt_len:
+            return
+        if self._cache is not None:
+            # Register the FULL prompt blocks for future hits (partial
+            # tails stay private: the owner's decode appends into them).
+            n_full = req.prompt_len // self.block_size
+            self._cache.insert(
+                req.prompt, self._slot_blocks[req.slot][:n_full]
+            )
+        tok = int(jax.device_get(first))
+        req.first_token_ts = time.monotonic()
+        if req.requeues == 0:
+            self.metrics.ttft.observe(req.ttft_s)
+        req.tokens.append(tok)
+        self._tokens[req.slot] = tok
+        self.metrics.tokens.inc(kind="decode")
+        if len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, finished)
+        else:
+            req.state = DECODE
+
+    def _run_decode(self, decoding: List[Request],
+                    finished: List[Request]):
+        # Block-budget pass FIRST: growing a cursor past a block edge
+        # may preempt the youngest peer, which must then sit this
+        # iteration out.
+        for r in list(decoding):
+            if r.state != DECODE:
+                continue  # preempted by an earlier peer's allocation
+            cursor = min(self._lengths[r.slot], self.max_len - 1)
+            self._ensure_blocks(r, cursor + 1)
+            self._privatize(r, cursor // self.block_size)
+        decoding = [r for r in decoding if r.state == DECODE]
+        if not decoding:
+            return
+        active = np.zeros(self.slots, bool)
+        for r in decoding:
+            active[r.slot] = True
+        self._k, self._v, nxt = self._steps.decode(
+            self._k, self._v, self._params,
+            jnp.asarray(self._tables),
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(active), jnp.asarray(self._temps),
+            self._rng, np.int32(self._step_idx),
+        )
+        nxt = np.asarray(jax.device_get(nxt))
+        for r in decoding:
+            self._lengths[r.slot] += 1
+            tok = int(nxt[r.slot])
+            r.tokens.append(tok)
+            self._tokens[r.slot] = tok
+            self.metrics.tokens.inc(kind="decode")
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish(r, finished)
+            elif self._lengths[r.slot] + 1 > self.max_len:
+                r.truncated = True
+                self._finish(r, finished)
+
+    # ---- observability -----------------------------------------------------
+
+    def kv_stats(self) -> Dict[str, object]:
+        """Allocator + prefix-cache accounting (heartbeats, SignalBus,
+        bench, the chaos block-reclaim invariant)."""
+        stats = dict(self._allocator.stats(self._live_block_ids()))
+        stats["bytes_in_use"] = (
+            (stats["used"] + stats["cached"]) * self._block_bytes
+        )
+        stats["cow_copies"] = self._allocator.cow_copies_total
+        if self._cache is not None:
+            for key, value in self._cache.stats().items():
+                stats[f"prefix_{key}"] = value
+            # Report USABLE hits (blocks that actually skipped
+            # prefill), not the cache's raw lookup counters: a hit
+            # fully discarded by chunk alignment saved nothing.
+            lookups = self._prefix_hits + self._prefix_misses
+            stats["prefix_hits"] = self._prefix_hits
+            stats["prefix_misses"] = self._prefix_misses
+            stats["prefix_hit_blocks"] = self._prefix_hit_blocks
+            stats["prefix_hit_rate"] = round(
+                self._prefix_hits / lookups if lookups else 0.0, 4
+            )
+        return stats
+
+    def check_block_invariants(self) -> None:
+        """Raise unless conservation + refcount sanity hold (tests)."""
+        self._allocator.check()
+        stats = self._allocator.stats(self._live_block_ids())
+        total = stats["free"] + stats["used"] + stats["cached"]
+        if total != self._allocator.managed:
+            raise AssertionError(
+                f"free+used+cached {total} != managed "
+                f"{self._allocator.managed}: {stats}"
+            )

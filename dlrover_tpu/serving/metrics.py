@@ -66,8 +66,14 @@ class ServingMetrics:
         self.shed = reg.counter(
             "serving_requests_shed_total",
             "queued requests dropped before admission, by reason "
-            '(reason="deadline": past their TTL, never prefillled)',
-            labelnames=("reason",),
+            '(reason="deadline": past their TTL, never prefillled) '
+            "and SLO class",
+            labelnames=("reason", "slo_class"),
+        )
+        self.class_queue_depth = reg.gauge(
+            "serving_class_queue_depth",
+            "requests waiting for a slot, per SLO class",
+            labelnames=("slo_class",),
         )
         self.failures = reg.counter(
             "serving_requests_failed_total",
@@ -84,6 +90,43 @@ class ServingMetrics:
             "serving_token_latency_seconds",
             "per-decoded-token latency (iteration wall time)",
             buckets=_LATENCY_BUCKETS,
+        )
+        # ---- paged KV pool (serving/kvpool, §31) ------------------------
+        self.kv_blocks = reg.gauge(
+            "serving_kv_blocks",
+            "paged KV pool blocks by state (free | used: referenced by "
+            "a live slot's block table | cached: held warm by the "
+            "prefix cache only); states sum to the managed pool size",
+            labelnames=("state",),
+        )
+        self.kv_blocks_total = reg.gauge(
+            "serving_kv_blocks_total",
+            "managed (allocatable) blocks in the paged KV pool",
+        )
+        self.kv_bytes_in_use = reg.gauge(
+            "serving_kv_bytes_in_use",
+            "bytes of KV pool HBM referenced by live slots or the "
+            "prefix cache (allocated blocks x block bytes, K+V)",
+        )
+        self.prefix_lookups = reg.counter(
+            "serving_prefix_lookups_total",
+            "prefix-cache lookups at admission, by outcome",
+            labelnames=("outcome",),
+        )
+        self.prefix_hit_blocks = reg.counter(
+            "serving_prefix_hit_blocks_total",
+            "warm blocks handed to admitted requests by the prefix "
+            "cache (each skips block_size tokens of prefill)",
+        )
+        self.kv_cow_copies = reg.counter(
+            "serving_kv_cow_copies_total",
+            "copy-on-write block privatizations (a shared block was "
+            "about to be rewritten)",
+        )
+        self.kv_preemptions = reg.counter(
+            "serving_kv_preemptions_total",
+            "requests preempted (re-queued, progress reset) to free "
+            "blocks for an older request under pool pressure",
         )
 
     def annotate(self, event: str, **fields):

@@ -15,6 +15,19 @@ step's one-token-per-active-slot, and the chunk only runs when
 Lowering the budget protects decode latency from prefill bursts;
 the default (prefill_chunk + slots) never blocks a chunk.
 
+**SLO classes (§31).** Admission is no longer bare FCFS: requests
+carry a named :class:`SloClass` (e.g. ``interactive`` — TTFT-bound —
+vs ``batch`` — throughput-bound), and free slots are granted by
+weighted-fair deficit round-robin over the classes with queued work:
+each replenish adds ``weight`` credits per class, each admission costs
+one, the class with the most credit (ties break on declaration order)
+admits its OLDEST request. One class degenerates to exact FCFS — the
+pre-§31 behavior, and the default when no classes are configured.
+Classes also carry a default deadline, and expiry is checked at
+admission time too: a request whose deadline lapsed while it waited
+for a free slot is shed the moment it would otherwise win a slot
+(``drain_admission_shed``), not just at the engine's pump-time sweep.
+
 The scheduler is deliberately jax-free — pure host bookkeeping the
 engine drives — so its policies are unit-testable without tracing.
 """
@@ -23,7 +36,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -32,6 +45,60 @@ QUEUED = "queued"
 PREFILL = "prefill"
 DECODE = "decode"
 DONE = "done"
+
+
+@dataclass(frozen=True)
+class SloClass:
+    """One named service class. ``weight`` is the admission share under
+    weighted-fair deficit round-robin (interactive traffic typically
+    outweighs batch); ``default_deadline_s`` applies when a submission
+    names no deadline of its own (None = no TTL)."""
+
+    name: str
+    weight: float = 1.0
+    default_deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("SloClass needs a name")
+        if self.weight <= 0:
+            raise ValueError(
+                f"SloClass {self.name!r} weight must be > 0"
+            )
+
+
+# The conventional two-class split: TTFT-bound interactive traffic gets
+# 4x the admission share of throughput-bound batch work.
+DEFAULT_SLO_CLASSES: Tuple[SloClass, ...] = (
+    SloClass("interactive", weight=4.0),
+    SloClass("batch", weight=1.0),
+)
+
+# What a fleet replica worker serves unless told otherwise: untagged
+# traffic lands in "default" (the first class), and the conventional
+# interactive/batch split is understood on the wire — a router's
+# tagged request must not be REJECTED by a stock replica.
+FLEET_SLO_CLASSES: Tuple[SloClass, ...] = (
+    SloClass("default", weight=1.0),
+) + DEFAULT_SLO_CLASSES
+
+
+def parse_slo_classes(spec: str) -> Tuple[SloClass, ...]:
+    """``"name:weight,name:weight"`` → SloClass tuple (CLI surface).
+    The first named class is the default for untagged submissions."""
+    classes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, weight = part.split(":", 1)
+            classes.append(SloClass(name.strip(), float(weight)))
+        else:
+            classes.append(SloClass(part))
+    if not classes:
+        raise ValueError(f"no SLO classes in spec {spec!r}")
+    return tuple(classes)
 
 
 @dataclass
@@ -53,6 +120,7 @@ class Request:
     # (shed from the queue past its TTL), or a caller-supplied reason.
     failure_reason: str = ""
     requeues: int = 0                  # step-error restarts of this request
+    preemptions: int = 0               # pool-pressure evictions (§31)
     submit_ts: float = 0.0
     # Absolute deadline on the submit clock; a QUEUED request past it is
     # shed (never admitted to prefill) — a dead client's request must
@@ -69,6 +137,12 @@ class Request:
     # router's attempt span, or None): the emitted phase spans parent
     # to it so one request is one tree across processes.
     trace: Optional[dict] = None
+    # Named SLO class this request was admitted under (§31); "default"
+    # on single-class schedulers.
+    slo_class: str = "default"
+    # Paged engines (serving/kvpool): warm prefix-cache blocks this
+    # request's block table started from — 0 on a miss or a flat engine.
+    prefix_hit_blocks: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -91,6 +165,7 @@ class Scheduler:
         prefill_chunk: int,
         token_budget: Optional[int] = None,
         drain_mode: bool = False,
+        slo_classes: Optional[Sequence[SloClass]] = None,
     ):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
@@ -105,7 +180,30 @@ class Scheduler:
         # against: admit a full batch, run it to completion, only then
         # refill — no slot is recycled while any peer still decodes.
         self.drain_mode = drain_mode
+        classes = tuple(slo_classes) if slo_classes else (
+            SloClass("default"),
+        )
+        self.slo_classes: Dict[str, SloClass] = {}
+        for cls in classes:
+            if cls.name in self.slo_classes:
+                raise ValueError(f"duplicate SLO class {cls.name!r}")
+            self.slo_classes[cls.name] = cls
+        self._default_class = classes[0].name
+        # Deficit round-robin credits; replenished by weight whenever
+        # every class with queued work is out of credit.
+        self._credits: Dict[str, float] = {
+            name: 0.0 for name in self.slo_classes
+        }
         self.queue: Deque[Request] = deque()
+        # Requests shed at admission time (deadline lapsed while
+        # waiting for a slot); the engine drains and reports them with
+        # the same metrics/spans as pump-time sheds.
+        self._admission_shed: List[Request] = []
+        # Optional engine veto on the next admission (the paged
+        # engine's block watermark: admitting a request the pool
+        # cannot hold would only thrash preemptions). Returning False
+        # stops THIS admission round; the request keeps its place.
+        self.admission_gate = None
         self.by_slot: List[Optional[Request]] = [None] * slots
         self._free: Deque[int] = deque(range(slots))
         self._rid = itertools.count()
@@ -119,6 +217,7 @@ class Scheduler:
         temperature: float = 0.0,
         now: Optional[float] = None,
         deadline_s: Optional[float] = None,
+        slo_class: Optional[str] = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
@@ -132,6 +231,17 @@ class Scheduler:
             )
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
+        cls_name = slo_class if slo_class is not None else (
+            self._default_class
+        )
+        cls = self.slo_classes.get(cls_name)
+        if cls is None:
+            raise ValueError(
+                f"unknown SLO class {cls_name!r}; configured: "
+                f"{sorted(self.slo_classes)}"
+            )
+        if deadline_s is None:
+            deadline_s = cls.default_deadline_s
         submit_ts = now if now is not None else time.monotonic()
         req = Request(
             rid=next(self._rid),
@@ -142,9 +252,16 @@ class Scheduler:
             deadline=(
                 submit_ts + deadline_s if deadline_s is not None else None
             ),
+            slo_class=cls_name,
         )
         self.queue.append(req)
         return req
+
+    def queue_depth_by_class(self) -> Dict[str, int]:
+        depths = {name: 0 for name in self.slo_classes}
+        for req in self.queue:
+            depths[req.slo_class] = depths.get(req.slo_class, 0) + 1
+        return depths
 
     def shed_expired(self, now: Optional[float] = None) -> List[Request]:
         """Drop QUEUED requests past their deadline — they are never
@@ -171,19 +288,120 @@ class Scheduler:
         return shed
 
     def admit(self, now: Optional[float] = None) -> List[Request]:
-        """Bind queued requests to free slots (FCFS). Under drain_mode,
+        """Bind queued requests to free slots — weighted-fair deficit
+        round-robin across SLO classes, FCFS within a class (one class
+        = exact FCFS). A request whose deadline lapsed while it waited
+        is shed HERE, the moment it would have won a slot, and surfaces
+        through :meth:`drain_admission_shed`. Under drain_mode, admits
         only when EVERY slot is free — the drain-and-refill baseline."""
         if self.drain_mode and len(self._free) < self.slots:
             return []
+        if now is None:
+            now = time.monotonic()
         admitted = []
         while self.queue and self._free:
-            req = self.queue.popleft()
+            req = self._next_admission(now)
+            if req is None:
+                break
             req.slot = self._free.popleft()
             req.state = PREFILL
-            req.admit_ts = now if now is not None else time.monotonic()
+            req.admit_ts = now
             self.by_slot[req.slot] = req
             admitted.append(req)
         return admitted
+
+    def _next_admission(self, now: float) -> Optional[Request]:
+        """The weighted-fair winner among per-class queue heads;
+        expired candidates are shed on the way (admission-time TTL).
+        DRR credit is charged only for an admission that actually
+        happens: sheds and admission-gate vetoes are free, so pool
+        pressure cannot invert the configured class weights. The
+        single-class path is O(1) (queue head); the multi-class head
+        scan stops once every class has a head, and ``deque.remove``
+        of a head is near-front."""
+        while True:
+            if not self.queue:
+                return None
+            charge = False
+            if len(self.slo_classes) == 1:
+                req = self.queue[0]
+                if (
+                    not self._expired(req, now)
+                    and self._gate_vetoes(req)
+                ):
+                    return None
+                self.queue.popleft()
+            else:
+                heads: Dict[str, Request] = {}
+                for queued in self.queue:
+                    if queued.slo_class not in heads:
+                        heads[queued.slo_class] = queued
+                        if len(heads) == len(self.slo_classes):
+                            break
+                if len(heads) == 1:
+                    name = next(iter(heads))
+                    charge = False
+                else:
+                    cands = {n: self._credits[n] for n in heads}
+                    if max(cands.values()) <= 0:
+                        # Replenish the classes with queued work; idle
+                        # classes reset — credit hoarded while idle
+                        # would let a burst starve everyone else later.
+                        for n, cls in self.slo_classes.items():
+                            self._credits[n] = (
+                                self._credits[n] + cls.weight
+                                if n in heads else 0.0
+                            )
+                        cands = {n: self._credits[n] for n in heads}
+                    # Deterministic tie-break: declaration order.
+                    name = max(
+                        heads,
+                        key=lambda n: (
+                            cands[n],
+                            -list(self.slo_classes).index(n),
+                        ),
+                    )
+                    charge = True
+                req = heads[name]
+                if (
+                    not self._expired(req, now)
+                    and self._gate_vetoes(req)
+                ):
+                    # Veto before any charge or removal: the request
+                    # keeps its place AND its class keeps its credit.
+                    return None
+                if charge:
+                    self._credits[name] -= 1.0
+                self.queue.remove(req)
+            if self._expired(req, now):
+                # Lapsed while waiting for a slot: shed instead of
+                # burning prefill on a dead client (single-head paths
+                # charged nothing; a charged multi-class credit is
+                # refunded — sheds must not tilt the DRR ratio).
+                if charge:
+                    self._credits[req.slo_class] += 1.0
+                req.state = DONE
+                req.failed = True
+                req.failure_reason = "deadline"
+                req.finish_ts = now
+                self._admission_shed.append(req)
+                continue
+            return req
+
+    def _expired(self, req: Request, now: float) -> bool:
+        return req.deadline is not None and now > req.deadline
+
+    def _gate_vetoes(self, req: Request) -> bool:
+        return (
+            self.admission_gate is not None
+            and not self.admission_gate(req)
+        )
+
+    def drain_admission_shed(self) -> List[Request]:
+        """Requests shed by :meth:`admit`'s deadline check; the engine
+        reports them exactly like pump-time sheds."""
+        out, self._admission_shed = self._admission_shed, []
+        return out
 
     # ---- per-iteration work selection -------------------------------------
 
@@ -226,6 +444,27 @@ class Scheduler:
         finish(); split so callers/metrics can tell outcomes apart."""
         self.finish(req, now)
 
+    def preempt(self, req: Request) -> None:
+        """Pool-pressure preemption (paged engine, §31): return ONE
+        in-slot request to the FRONT of the queue with its progress
+        reset, freeing its slot (and, at the engine, its blocks) for an
+        older request. Unlike a step-error requeue this does NOT count
+        against the request's requeue budget — being the youngest when
+        the pool runs dry is scheduling, not failure."""
+        if req.slot >= 0:
+            self.by_slot[req.slot] = None
+            self._free.append(req.slot)
+            req.slot = -1
+        req.state = QUEUED
+        req.prefill_pos = 0
+        req.tokens = []
+        req.truncated = False
+        req.first_token_ts = None
+        req.admit_ts = None
+        req.prefix_hit_blocks = 0
+        req.preemptions += 1
+        self.queue.appendleft(req)
+
     # ---- failure recovery --------------------------------------------------
 
     def requeue_active(self) -> List[Request]:
@@ -248,6 +487,7 @@ class Scheduler:
             req.truncated = False
             req.first_token_ts = None
             req.admit_ts = None
+            req.prefix_hit_blocks = 0
             req.requeues += 1
             self.queue.appendleft(req)
         return victims

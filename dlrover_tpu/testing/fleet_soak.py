@@ -52,6 +52,13 @@ class FleetSoakConfig:
     prefill_chunk: int = 8
     watchdog_s: float = 180.0
     keep_artifacts_on_success: bool = False
+    # Paged-KV replicas (§31): heartbeats then carry allocator stats
+    # and the episode asserts the BLOCK-RECLAIM invariant — after the
+    # mid-run kill and reroute, free+used+cached blocks still sum to
+    # the managed pool on every replica and no refcount went negative
+    # (a block leak under crash is a regression from day one).
+    paged: bool = True
+    block_size: int = 8
 
 
 def build_fleet_schedules(
@@ -129,6 +136,7 @@ def run_fleet_episode(
                 str(i), ep_dir,
                 slots=cfg.slots, max_len=cfg.max_len,
                 prefill_chunk=cfg.prefill_chunk,
+                paged=cfg.paged, block_size=cfg.block_size,
                 # Per-generation: the victim's SIGKILL schedule arms
                 # only generation 0 — its post-restart generations run
                 # clean, so the half-open probes can actually succeed.
@@ -243,6 +251,9 @@ def run_fleet_episode(
         _check_fleet_invariant(
             accepted, router, registry, victim, health_seen
         )
+        if cfg.paged:
+            kv_final = _check_block_reclaim(replicas, victim)
+            report["kv_blocks"] = kv_final
         trace_stats = _check_trace_invariant(
             episode_spans,
             require_reroute=registry.get(
@@ -352,6 +363,43 @@ def _check_trace_invariant(spans, require_reroute: bool) -> Dict:
             "queue-wait/prefill/decode phase tree"
         )
     return {"rerouted_trees": rerouted, "phase_sum_checked": checked}
+
+
+def _check_block_reclaim(replicas, victim) -> Dict:
+    """The §31 block-reclaim invariant: every paged replica reported
+    allocator stats, none EVER violated conservation (free+used+cached
+    == managed pool, checked at each heartbeat's receipt) or went
+    refcount-negative — including the victim across its SIGKILL and
+    restart, whose post-restart generations must report again."""
+    final: Dict = {}
+    for replica in replicas:
+        rid = replica.replica_id
+        if replica.kv_violation is not None:
+            raise SoakInvariantError(
+                f"block-reclaim invariant violated: "
+                f"{replica.kv_violation}"
+            )
+        kv = replica.last_kv
+        if not kv:
+            raise SoakInvariantError(
+                f"paged replica {rid} never reported allocator stats "
+                f"on its heartbeats"
+            )
+        final[rid] = {
+            k: kv.get(k) for k in ("total", "free", "used", "cached")
+        }
+        if kv["free"] + kv["used"] + kv["cached"] != kv["total"]:
+            raise SoakInvariantError(
+                f"replica {rid} final block accounting broken: {kv}"
+            )
+    # The victim respawned at least once: its reporting generation is
+    # post-kill, so a leak across the crash would have surfaced either
+    # as a survivor's violation (rerouted work) or a missing report.
+    if victim not in final:
+        raise SoakInvariantError(
+            f"victim replica {victim} has no final allocator stats"
+        )
+    return final
 
 
 def _check_fleet_invariant(accepted, router, registry, victim,

@@ -58,3 +58,8 @@ def pytest_configure(config):
         "autoscale: closed-loop autoscaler (signal bus, rule policy, "
         "actuators, static-vs-autoscaled soak A/B) — docs/DESIGN.md §30",
     )
+    config.addinivalue_line(
+        "markers",
+        "kvpool: paged KV memory plane (block-table cache, prefix "
+        "reuse, COW, SLO-class admission) — docs/DESIGN.md §31",
+    )

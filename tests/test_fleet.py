@@ -696,7 +696,7 @@ def test_scheduler_inflight_requests_not_shed():
 
     sch = Scheduler(slots=1, max_len=32, prefill_chunk=8)
     req = sch.submit([1, 2], 4, now=10.0, deadline_s=1.0)
-    sch.admit()                          # bound to a slot: KV is sunk
+    sch.admit(now=10.5)                  # bound to a slot: KV is sunk
     assert sch.shed_expired(now=99.0) == []
     assert not req.failed
 
@@ -719,7 +719,8 @@ def test_engine_deadline_shed_counts_and_surfaces():
                         prefill_chunk=8)
     eng.warmup()
     # Serving metrics live on the process-global registry: assert deltas.
-    shed0 = eng.metrics.shed.value(reason="deadline")
+    shed0 = eng.metrics.shed.value(reason="deadline",
+                                   slo_class="default")
     fail0 = eng.metrics.failures.value(reason="deadline")
     req0 = eng.metrics.requests.value(outcome="shed")
     doomed = eng.submit([1, 2, 3], 3, deadline_s=1e-6)
@@ -730,7 +731,9 @@ def test_engine_deadline_shed_counts_and_surfaces():
     assert doomed.failed and doomed.failure_reason == "deadline"
     assert doomed.state == DONE and not doomed.tokens
     assert live.tokens and not live.failed
-    assert eng.metrics.shed.value(reason="deadline") - shed0 == 1
+    assert eng.metrics.shed.value(
+        reason="deadline", slo_class="default"
+    ) - shed0 == 1
     assert eng.metrics.failures.value(reason="deadline") - fail0 == 1
     assert eng.metrics.requests.value(outcome="shed") - req0 == 1
 

@@ -1,0 +1,505 @@
+"""Paged KV memory plane (§31): allocator alloc/free/refcount/COW
+properties, paged ragged decode token-exact vs the flat pool, prefix
+cache hits actually skipping prefill, recycled blocks leaking no KV,
+zero retraces across admissions with varying block tables, SLO-class
+weighted-fair admission + admission-time deadline sheds, and the paged
+Pallas decode kernel's parity through a shuffled block table."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.serving import ServingEngine, Scheduler, SloClass
+from dlrover_tpu.serving.kvpool import (
+    BlockAllocator,
+    BlockPoolExhausted,
+    PagedServingEngine,
+    PrefixCache,
+)
+
+pytestmark = pytest.mark.kvpool
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def naive_greedy(cfg, params, prompt, max_new):
+    seq = jnp.asarray(prompt, jnp.int32)[None, :]
+    out = []
+    for _ in range(max_new):
+        logits, _ = llama.forward(cfg, params, seq)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        out.append(int(nxt[0]))
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    return out
+
+
+def make_prompts(cfg, lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        rs.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+        for n in lens
+    ]
+
+
+# ---- allocator properties ---------------------------------------------------
+
+
+def test_allocator_alloc_free_conservation():
+    a = BlockAllocator(9, reserved=1)
+    assert a.managed == 8
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.free_count() == 5
+    for b in got:
+        assert a.refcount(b) == 1
+        assert a.decref(b)            # freed
+    assert a.free_count() == 8
+    a.check()
+    with pytest.raises(ValueError):
+        a.decref(got[0])              # double free raises
+
+
+def test_allocator_all_or_nothing_exhaustion():
+    a = BlockAllocator(5, reserved=1)
+    a.alloc(3)
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc(2)                    # only 1 free: nothing granted
+    assert a.free_count() == 1
+    a.check()
+
+
+def test_allocator_refcount_and_cow():
+    a = BlockAllocator(6, reserved=1)
+    (b,) = a.alloc(1)
+    # Sole owner: ensure_private is the identity, no copy.
+    same, copied = a.ensure_private(b)
+    assert same == b and not copied
+    a.incref(b)                       # a second owner appears
+    new, copied = a.ensure_private(b)
+    assert copied and new != b
+    assert a.refcount(b) == 1         # the other owner keeps the old
+    assert a.refcount(new) == 1
+    assert a.cow_copies_total == 1
+    a.check()
+
+
+def test_allocator_stats_split_used_vs_cached():
+    a = BlockAllocator(8, reserved=1)
+    blocks = a.alloc(4)
+    stats = a.stats(live_blocks=blocks[:3])
+    assert stats == {
+        "total": 7, "free": 3, "used": 3, "cached": 1,
+        "min_ref": 1, "negative_refs": 0,
+    }
+
+
+# ---- prefix cache properties ------------------------------------------------
+
+
+def test_prefix_cache_insert_lookup_refcounts():
+    a = BlockAllocator(17, reserved=1)
+    cache = PrefixCache(a, block_size=4)
+    prompt = np.arange(10, dtype=np.int32)     # 2 full blocks + tail
+    blocks = a.alloc(3)
+    assert cache.insert(prompt, blocks[:2]) == 2   # tail never cached
+    assert a.refcount(blocks[0]) == 2              # slot + cache
+    hit = cache.lookup(prompt)
+    assert hit == blocks[:2]
+    assert a.refcount(blocks[0]) == 3              # + the new borrower
+    # A diverging prompt shares only the common full blocks.
+    other = prompt.copy()
+    other[6] += 1                                  # diverge in block 1
+    assert cache.lookup(other) == blocks[:1]
+    # Unrelated prompt: clean miss.
+    assert cache.lookup(np.arange(100, 108, dtype=np.int32)) == []
+    assert cache.hits_total == 2 and cache.misses_total == 1
+
+
+def test_prefix_cache_leaf_first_eviction_frees_blocks():
+    a = BlockAllocator(17, reserved=1)
+    cache = PrefixCache(a, block_size=4)
+    prompt = np.arange(12, dtype=np.int32)         # 3 full blocks
+    blocks = a.alloc(3)
+    cache.insert(prompt, blocks)
+    for b in blocks:
+        a.decref(b)                                # slot released
+    assert a.stats()["cached"] == 3
+    # One eviction takes the LEAF (block 2), never an interior entry.
+    assert cache.evict_lru(1) == 1
+    assert a.refcount(blocks[2]) == 0              # freed
+    assert a.refcount(blocks[0]) == 1              # chain head intact
+    assert cache.lookup(prompt) == blocks[:2]      # prefix still hits
+    for b in blocks[:2]:
+        a.decref(b)
+    cache.clear()
+    a.check()
+    assert a.free_count() == a.managed
+
+
+# ---- paged engine: exactness, reuse, retraces -------------------------------
+
+
+def test_paged_ragged_decode_matches_flat_and_teacher_forced(tiny):
+    """The ISSUE acceptance bar: same staggered ragged workload through
+    the flat engine and the paged engine (greedy) — token-exact against
+    each other AND the teacher-forced reference."""
+    cfg, params = tiny
+    prompts = make_prompts(cfg, (5, 3, 9), seed=1)
+    plans = list(zip(prompts, (6, 5, 4)))
+
+    def run(engine):
+        reqs = [engine.submit(prompts[0], 6)]
+        for _ in range(4):
+            engine.step()
+        reqs.append(engine.submit(prompts[1], 5))
+        reqs.append(engine.submit(prompts[2], 4))
+        engine.run_until_idle()
+        return [r.tokens for r in reqs]
+
+    flat = ServingEngine(cfg, params, slots=2, max_len=32,
+                         prefill_chunk=4)
+    flat.warmup()
+    paged = PagedServingEngine(cfg, params, slots=2, max_len=32,
+                               prefill_chunk=4, block_size=8)
+    paged.warmup()
+    flat_tokens = run(flat)
+    paged_tokens = run(paged)
+    assert paged_tokens == flat_tokens
+    for tokens, (prompt, max_new) in zip(paged_tokens, plans):
+        assert tokens == naive_greedy(cfg, params, prompt, max_new)
+    paged.check_block_invariants()
+
+
+def test_prefix_cache_hit_skips_prefill_and_stays_exact(tiny):
+    """A repeated prompt must HIT (prefill chunks skipped — measured by
+    the engine's prefill-token counter), decode the exact same greedy
+    tokens, and leave the allocator conserved."""
+    cfg, params = tiny
+    eng = PagedServingEngine(cfg, params, slots=2, max_len=32,
+                             prefill_chunk=4, block_size=8)
+    eng.warmup()
+    (prompt,) = make_prompts(cfg, (17,), seed=3)   # 2 full blocks + 1
+    ref = naive_greedy(cfg, params, prompt, 5)
+    r1 = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    assert r1.tokens == ref and r1.prefix_hit_blocks == 0
+    first_prefill = eng.metrics.tokens.value(kind="prefill")
+    r2 = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    assert r2.tokens == ref
+    assert r2.prefix_hit_blocks == 2
+    resumed_prefill = (
+        eng.metrics.tokens.value(kind="prefill") - first_prefill
+    )
+    # 17-token prompt, 16 covered, resume at 16 (chunk-aligned): only
+    # the final 1-valid-token chunk re-runs.
+    assert resumed_prefill < first_prefill
+    assert resumed_prefill == 1
+    eng.check_block_invariants()
+
+
+def test_cow_privatizes_shared_block_on_rewrite(tiny):
+    """A fully-cached block-aligned prompt re-runs its last chunk (the
+    first token must be re-sampled) INTO a shared block: the write must
+    COW, both requests stay exact, refcounts stay sane."""
+    cfg, params = tiny
+    eng = PagedServingEngine(cfg, params, slots=2, max_len=32,
+                             prefill_chunk=4, block_size=8)
+    eng.warmup()
+    (prompt,) = make_prompts(cfg, (8,), seed=5)    # exactly one block
+    ref = naive_greedy(cfg, params, prompt, 5)
+    r1 = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    r2 = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    assert r1.tokens == ref and r2.tokens == ref
+    assert eng.kv_stats()["cow_copies"] >= 1
+    eng.check_block_invariants()
+
+
+def test_recycled_block_does_not_leak_kv(tiny):
+    """Blocks freed by a long request and re-allocated to a short one
+    must not leak the previous occupant's KV (cache disabled so reuse
+    is guaranteed)."""
+    cfg, params = tiny
+    eng = PagedServingEngine(cfg, params, slots=1, max_len=32,
+                             prefill_chunk=8, block_size=8,
+                             prefix_cache=False)
+    eng.warmup()
+    long_p, short_p = make_prompts(cfg, (12, 3), seed=2)
+    r_long = eng.submit(long_p, 12)
+    eng.run_until_idle()
+    assert r_long.state == "done" and len(r_long.tokens) == 12
+    assert eng.kv_stats()["free"] == eng.num_blocks - 1  # all recycled
+    r_short = eng.submit(short_p, 6)
+    eng.run_until_idle()
+    assert r_short.tokens == naive_greedy(cfg, params, short_p, 6)
+    eng.check_block_invariants()
+
+
+def test_no_retrace_across_admissions_with_varying_tables(tiny):
+    """After warmup, admissions with new prompt lengths, temperatures,
+    prefix hits, COW copies, and block churn must trace NOTHING — every
+    dynamic quantity (tables included) is a traced argument."""
+    cfg, params = tiny
+    eng = PagedServingEngine(cfg, params, slots=2, max_len=32,
+                             prefill_chunk=4, block_size=8)
+    eng.warmup()
+    base = dict(eng.trace_counts)
+    rs = np.random.RandomState(3)
+    for plen, mnew, temp in (
+        (2, 3, 0.0), (8, 2, 0.9), (11, 5, 0.3), (8, 9, 1.7),
+    ):
+        prompt = rs.randint(0, cfg.vocab_size, plen).astype(np.int32)
+        eng.submit(prompt, mnew, temperature=temp)
+        # And a guaranteed repeat (hit + COW path) mid-stream.
+    (prompt,) = make_prompts(cfg, (8,), seed=9)
+    eng.submit(prompt, 3)
+    eng.submit(prompt, 3)
+    eng.run_until_idle()
+    assert eng.trace_counts == base, (
+        f"retraced: {eng.trace_counts} vs {base}"
+    )
+    eng.check_block_invariants()
+
+
+def test_oversubscribed_pool_preempts_youngest_and_conserves(tiny):
+    """More logical slot capacity than physical blocks: the pool runs
+    dry mid-decode, the youngest request is preempted (front-requeued,
+    NOT failed) and everything still completes with exact tokens."""
+    cfg, params = tiny
+    eng = PagedServingEngine(cfg, params, slots=4, max_len=32,
+                             prefill_chunk=8, block_size=8,
+                             num_blocks=10, prefix_cache=False)
+    eng.warmup()
+    prompts = make_prompts(cfg, (12, 12, 12, 12), seed=7)
+    reqs = [eng.submit(p, 16) for p in prompts]
+    eng.run_until_idle()
+    assert all(r.state == "done" and not r.failed for r in reqs)
+    assert eng.metrics.kv_preemptions.value() >= 1
+    for req, prompt in zip(reqs, prompts):
+        assert req.tokens == naive_greedy(cfg, params, prompt, 16)
+    eng.check_block_invariants()
+    assert eng.kv_stats()["free"] == eng.num_blocks - 1
+
+
+# ---- SLO-class scheduling ---------------------------------------------------
+
+
+def test_slo_weighted_fair_admission_ratio():
+    """3:1 weights with both classes saturated: admissions interleave
+    ~3 interactive per 1 batch, FCFS within each class."""
+    classes = (SloClass("interactive", weight=3.0),
+               SloClass("batch", weight=1.0))
+    sch = Scheduler(slots=4, max_len=64, prefill_chunk=8,
+                    slo_classes=classes)
+    for i in range(8):
+        sch.submit(np.zeros(4, np.int32) + i, 4,
+                   slo_class="interactive")
+        sch.submit(np.zeros(4, np.int32) + i, 4, slo_class="batch")
+    first = sch.admit(now=1.0)
+    assert [r.slo_class for r in first] == [
+        "interactive", "interactive", "interactive", "batch",
+    ]
+    # Interactive admissions kept FCFS order.
+    inter = [r for r in first if r.slo_class == "interactive"]
+    assert [r.rid for r in inter] == sorted(r.rid for r in inter)
+    # Drain and refill: the ratio persists across rounds.
+    for r in first:
+        sch.finish(r)
+    second = sch.admit(now=2.0)
+    assert [r.slo_class for r in second].count("interactive") == 3
+
+
+def test_slo_single_class_is_fcfs():
+    sch = Scheduler(slots=2, max_len=64, prefill_chunk=8)
+    reqs = [sch.submit(np.zeros(4, np.int32), 4) for _ in range(3)]
+    admitted = sch.admit(now=1.0)
+    assert [r.rid for r in admitted] == [reqs[0].rid, reqs[1].rid]
+    assert all(r.slo_class == "default" for r in admitted)
+
+
+def test_slo_unknown_class_rejected():
+    sch = Scheduler(slots=1, max_len=64, prefill_chunk=8)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        sch.submit(np.zeros(4, np.int32), 4, slo_class="platinum")
+
+
+def test_slo_class_default_deadline_applies():
+    classes = (SloClass("interactive", default_deadline_s=0.5),)
+    sch = Scheduler(slots=1, max_len=64, prefill_chunk=8,
+                    slo_classes=classes)
+    req = sch.submit(np.zeros(4, np.int32), 4, now=10.0)
+    assert req.deadline == pytest.approx(10.5)
+
+
+def test_slo_admission_time_deadline_shed():
+    """A queued request whose TTL lapses while WAITING for a slot is
+    shed at the admission decision (satellite: not only at pump time),
+    and the next-in-class request takes the slot instead."""
+    sch = Scheduler(slots=1, max_len=64, prefill_chunk=8)
+    doomed = sch.submit(np.zeros(4, np.int32), 4, now=10.0,
+                        deadline_s=1.0)
+    live = sch.submit(np.zeros(4, np.int32), 4, now=10.0)
+    admitted = sch.admit(now=99.0)      # doomed expired while queued
+    assert [r.rid for r in admitted] == [live.rid]
+    shed = sch.drain_admission_shed()
+    assert [r.rid for r in shed] == [doomed.rid]
+    assert doomed.failed and doomed.failure_reason == "deadline"
+
+
+def test_admission_gate_veto_preserves_drr_credit():
+    """A block-watermark veto must not charge the selected class's
+    deficit-round-robin credit: repeated vetoes under pool pressure
+    would otherwise invert the configured class weights."""
+    classes = (SloClass("interactive", weight=3.0),
+               SloClass("batch", weight=1.0))
+    sch = Scheduler(slots=4, max_len=64, prefill_chunk=8,
+                    slo_classes=classes)
+    for _ in range(4):
+        sch.submit(np.zeros(4, np.int32), 4, slo_class="interactive")
+        sch.submit(np.zeros(4, np.int32), 4, slo_class="batch")
+    vetoes = {"n": 0}
+
+    def gate(req):
+        vetoes["n"] += 1
+        return False
+
+    sch.admission_gate = gate
+    for _ in range(5):
+        assert sch.admit(now=1.0) == []
+    assert vetoes["n"] == 5
+    sch.admission_gate = None
+    admitted = sch.admit(now=2.0)
+    # The weighted-fair ratio survives the vetoed rounds untilted.
+    assert [r.slo_class for r in admitted] == [
+        "interactive", "interactive", "interactive", "batch",
+    ]
+
+
+def test_chunk_aligned_discarded_hit_reports_as_miss(tiny):
+    """A raw cache hit whose blocks are ALL discarded by chunk
+    alignment saved nothing: kv_stats must report it as a miss (the
+    review finding — raw cache counters overstate the win)."""
+    cfg, params = tiny
+    # chunk 16 > block 8: a 1-block hit on a 9-token prompt aligns
+    # start to 0 — the whole hit is discarded.
+    eng = PagedServingEngine(cfg, params, slots=2, max_len=32,
+                             prefill_chunk=16, block_size=8)
+    eng.warmup()
+    (prompt,) = make_prompts(cfg, (9,), seed=13)
+    eng.submit(prompt, 3)
+    eng.run_until_idle()
+    r2 = eng.submit(prompt, 3)
+    eng.run_until_idle()
+    assert r2.prefix_hit_blocks == 0
+    stats = eng.kv_stats()
+    assert stats["prefix_hits"] == 0
+    assert stats["prefix_hit_rate"] == 0.0
+    eng.check_block_invariants()
+
+
+def test_engine_shed_metrics_carry_slo_class(tiny):
+    from dlrover_tpu.observability.registry import MetricsRegistry
+
+    cfg, params = tiny
+    reg = MetricsRegistry()
+    eng = ServingEngine(
+        cfg, params, slots=1, max_len=32, prefill_chunk=8,
+        registry=reg,
+        slo_classes=(SloClass("interactive"), SloClass("batch")),
+    )
+    eng.warmup()
+    import time as time_lib
+
+    doomed = eng.submit([1, 2, 3], 3, deadline_s=1e-6,
+                        slo_class="batch")
+    live = eng.submit([4, 5, 6], 3, slo_class="interactive")
+    time_lib.sleep(0.01)
+    eng.run_until_idle()
+    assert doomed.failed and doomed.failure_reason == "deadline"
+    assert live.tokens and not live.failed
+    assert reg.get("serving_requests_shed_total").value(
+        reason="deadline", slo_class="batch"
+    ) == 1
+    # Per-class queue-depth gauge exists and settled to zero.
+    assert reg.get("serving_class_queue_depth").value(
+        slo_class="interactive"
+    ) == 0
+
+
+# ---- paged Pallas kernel ----------------------------------------------------
+
+
+def test_paged_decode_attention_matches_flat_through_shuffled_table():
+    """The block-table kernel (interpret mode on CPU) must equal the
+    flat length-aware kernel when the pool holds the same logical rows
+    scattered through a shuffled table."""
+    from dlrover_tpu.ops.decode_attention import (
+        decode_attention,
+        paged_decode_attention,
+    )
+
+    b, S, h, kh, d = 4, 64, 8, 4, 32
+    bs = 16
+    mb = S // bs
+    lens = jnp.array([1, 23, 40, 64], jnp.int32)
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    k_cache = jax.random.normal(ks[1], (b, S, kh, d), jnp.float32)
+    v_cache = jax.random.normal(ks[2], (b, S, kh, d), jnp.float32)
+
+    rs = np.random.RandomState(0)
+    tables = (rs.permutation(b * mb) + 1).reshape(b, mb).astype(np.int32)
+    nb_pool = b * mb + 1
+    k_pool = np.zeros((nb_pool, bs, kh, d), np.float32)
+    v_pool = np.zeros((nb_pool, bs, kh, d), np.float32)
+    for i in range(b):
+        for j in range(mb):
+            k_pool[tables[i, j]] = np.asarray(
+                k_cache[i, j * bs:(j + 1) * bs]
+            )
+            v_pool[tables[i, j]] = np.asarray(
+                v_cache[i, j * bs:(j + 1) * bs]
+            )
+
+    got = paged_decode_attention(
+        q, jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), lens,
+    )
+    ref = decode_attention(q, k_cache, v_cache, lens, block_k=bs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+# ---- autoscaler signal source -----------------------------------------------
+
+
+def test_kvpool_signal_source(tiny):
+    from dlrover_tpu.autoscaler import SignalBus, kvpool_source
+
+    cfg, params = tiny
+    eng = PagedServingEngine(
+        cfg, params, slots=2, max_len=32, prefill_chunk=8,
+        block_size=8,
+        slo_classes=(SloClass("interactive"), SloClass("batch")),
+    )
+    eng.warmup()
+    (p,) = make_prompts(cfg, (9,), seed=11)
+    eng.submit(p, 3, slo_class="interactive")
+    eng.run_until_idle()
+    bus = SignalBus().add_source("kv", kvpool_source(eng))
+    snap = bus.sample()
+    assert snap.get("kv.blocks_total") == eng.num_blocks - 1
+    assert snap.get("kv.blocks_free_frac") is not None
+    assert snap.get("kv.queue_depth.interactive") == 0
+    assert "kv.error" not in snap.values

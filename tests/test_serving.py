@@ -289,3 +289,11 @@ def test_bench_serving_speedup():
     assert r["retraces_after_warmup"] == 0
     assert r["speedup_vs_static"] >= 1.5, r
     assert r["ttft_p99_s"] <= r["static_ttft_p99_s"], r
+    # §31 equal-HBM acceptance: the paged pool admits strictly more
+    # effective concurrent slots, the prefix cache actually hits, and
+    # paged decode is token-exact (asserted inside run_paged_ab too).
+    assert r["kv_effective_slots"] > r["flat_effective_slots"], r
+    assert r["prefix_hit_rate"] > 0, r
+    assert r["paged_token_exact"] == 1 and (
+        r["paged_retraces_after_warmup"] == 0
+    ), r

@@ -38,26 +38,43 @@ sys.path.insert(
 )
 
 from dlrover_tpu.models import llama  # noqa: E402
+from dlrover_tpu.observability.registry import (  # noqa: E402
+    MetricsRegistry,
+)
 from dlrover_tpu.serving import ServingEngine  # noqa: E402
+from dlrover_tpu.serving.kvpool import PagedServingEngine  # noqa: E402
 
 
-def make_workload(n_requests: int, vocab: int, seed: int):
+def make_workload(n_requests: int, vocab: int, seed: int,
+                  prefix_share: float = 0.0, prefix_len: int = 48,
+                  greedy: bool = False):
     """[(arrival_s, prompt, max_new, temperature)] — Poisson arrivals,
     mixed prompt lengths, bimodal output lengths (75% short 8-16, 25%
     long 96-160: the heavy tail that makes drain-and-refill waste —
     a static batch decodes for its longest member while the other
-    slots sit finished)."""
+    slots sit finished). ``prefix_share`` makes that fraction of
+    prompts start with ONE fixed ``prefix_len``-token system prefix —
+    the shared-system-prompt workload the §31 prefix cache exists for.
+    ``greedy`` zeroes temperatures (the paged-vs-flat token-exactness
+    A/B needs determinism independent of scheduling)."""
     rs = np.random.RandomState(seed)
     arrivals = np.cumsum(rs.exponential(scale=0.003, size=n_requests))
+    system_prefix = rs.randint(
+        0, vocab, size=prefix_len
+    ).astype(np.int32)
     work = []
     for i in range(n_requests):
         prompt_len = int(rs.randint(8, 49))
         prompt = rs.randint(0, vocab, size=prompt_len).astype(np.int32)
+        if prefix_share > 0 and rs.rand() < prefix_share:
+            prompt = np.concatenate([system_prefix, prompt])
         if rs.rand() < 0.25:
             max_new = int(rs.randint(96, 161))
         else:
             max_new = int(rs.randint(8, 17))
         temp = 0.0 if rs.rand() < 0.5 else float(rs.uniform(0.5, 1.2))
+        if greedy:
+            temp = 0.0
         work.append((float(arrivals[i]), prompt, max_new, temp))
     return work
 
@@ -66,19 +83,24 @@ def _percentile(vals: List[float], q: float) -> float:
     return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
 
 
-def drive(engine: ServingEngine, workload) -> Dict[str, float]:
+def drive(engine: ServingEngine, workload,
+          return_finished: bool = False):
     """Feed the arrival schedule in (wall-clock) real time and step the
     engine until everything submitted has finished."""
     t0 = time.monotonic()
     pending = list(workload)
+    submitted = []
     finished = []
     iters = 0
     decode_slot_iters = 0
+    peak_active = 0
     while pending or engine.pending():
         now = time.monotonic() - t0
         while pending and pending[0][0] <= now:
             _, prompt, max_new, temp = pending.pop(0)
-            engine.submit(prompt, max_new, temperature=temp)
+            submitted.append(
+                engine.submit(prompt, max_new, temperature=temp)
+            )
         if not engine.pending():
             if pending:
                 time.sleep(
@@ -86,12 +108,13 @@ def drive(engine: ServingEngine, workload) -> Dict[str, float]:
                 )
             continue
         decode_slot_iters += len(engine.scheduler.decoding())
+        peak_active = max(peak_active, len(engine.scheduler.active()))
         finished.extend(engine.step())
         iters += 1
     wall = time.monotonic() - t0
     decoded = sum(len(r.tokens) for r in finished)
     ttfts = [r.ttft_s for r in finished if r.ttft_s is not None]
-    return {
+    out = {
         "wall_s": wall,
         "iterations": iters,
         "requests_done": len(finished),
@@ -101,8 +124,12 @@ def drive(engine: ServingEngine, workload) -> Dict[str, float]:
         "ttft_p99_s": _percentile(ttfts, 99),
         "slot_util": decode_slot_iters
         / max(iters * engine.slots, 1),
+        "peak_active_slots": peak_active,
         "truncated": sum(1 for r in finished if r.truncated),
     }
+    if return_finished:
+        return out, submitted
+    return out
 
 
 def run_bench(
@@ -189,7 +216,139 @@ def run_bench(
             / max(cont["tokens_per_s"], 1e-9),
             2,
         )
+    # Paged-vs-flat A/B at equal HBM on the prefix-share workload
+    # (§31): effective slots, prefix hit rate, token-exactness.
+    # Pick a block size compatible with the caller's shapes; odd
+    # shapes skip the paged leg instead of crashing the whole bench.
+    block_size = next(
+        (
+            bs for bs in (16, 8)
+            if max_len % bs == 0
+            and (prefill_chunk % bs == 0 or bs % prefill_chunk == 0)
+        ),
+        None,
+    )
+    if block_size is not None:
+        out.update(run_paged_ab(
+            slots=max(2, slots // 2),
+            n_requests=min(n_requests, 32),
+            max_len=max_len, prefill_chunk=prefill_chunk,
+            block_size=block_size, seed=seed,
+        ))
+    else:
+        out["paged_ab_skipped"] = (
+            f"no block size fits max_len={max_len} "
+            f"prefill_chunk={prefill_chunk}"
+        )
     return out
+
+
+def run_paged_ab(
+    slots: int = 4,
+    n_requests: int = 32,
+    max_len: int = 224,
+    prefill_chunk: int = 32,
+    block_size: int = 16,
+    seed: int = 0,
+    prefix_share: float = 0.6,
+) -> Dict[str, float]:
+    """Paged vs flat at EQUAL KV HBM budget (§31 acceptance A/B).
+
+    The flat engine gets ``slots`` rows of ``max_len``; the paged
+    engine gets the SAME number of KV rows as blocks (``slots *
+    max_len / block_size`` managed blocks) but twice the logical
+    slots — short requests hold few blocks, so the pool admits more
+    concurrent work from the bimodal stream. The workload is greedy
+    (temperature 0) and ``prefix_share`` of prompts open with one
+    shared system prefix, so three things are measured at once:
+
+    - ``kv_effective_slots`` vs ``flat_effective_slots``: peak
+      concurrently-admitted requests (the capacity win);
+    - ``prefix_hit_rate`` + prefill tokens actually skipped + TTFT of
+      shared-prefix requests that hit vs missed the cache;
+    - token-exactness: every request's greedy tokens must MATCH the
+      flat engine's, asserted, plus zero retraces after warmup.
+    """
+    cfg = llama.tiny_config()
+    params, _ = llama.init_params(cfg, __import__("jax").random.key(0))
+    workload = make_workload(
+        n_requests, cfg.vocab_size, seed,
+        prefix_share=prefix_share, greedy=True,
+    )
+    flat_reg, paged_reg = MetricsRegistry(), MetricsRegistry()
+    flat = ServingEngine(
+        cfg, params, slots=slots, max_len=max_len,
+        prefill_chunk=prefill_chunk, registry=flat_reg,
+    )
+    flat.warmup()
+    flat_m, flat_reqs = drive(flat, workload, return_finished=True)
+    paged = PagedServingEngine(
+        cfg, params, slots=2 * slots, max_len=max_len,
+        prefill_chunk=prefill_chunk, block_size=block_size,
+        num_blocks=slots * max_len // block_size + 1,
+        registry=paged_reg,
+    )
+    paged.warmup()
+    warm = dict(paged.trace_counts)
+    paged_m, paged_reqs = drive(paged, workload, return_finished=True)
+    retraces = sum(paged.trace_counts.values()) - sum(warm.values())
+    assert retraces == 0, (
+        f"paged steps retraced {retraces}x after warmup"
+    )
+    mismatches = [
+        i for i, (f, p) in enumerate(zip(flat_reqs, paged_reqs))
+        if f.tokens != p.tokens
+    ]
+    assert not mismatches, (
+        f"paged decode diverged from flat on requests {mismatches}"
+    )
+    paged.check_block_invariants()
+    stats = paged.kv_stats()
+    prefill_flat = flat_reg.get("serving_tokens_total").value(
+        kind="prefill"
+    )
+    prefill_paged = paged_reg.get("serving_tokens_total").value(
+        kind="prefill"
+    )
+    # TTFT among SHARED-prefix requests only (same length profile):
+    # cache hits vs the warm-up misses that prefilled the prefix.
+    shared = [
+        r for r, (_, prompt, _, _) in zip(paged_reqs, workload)
+        if len(prompt) > 48
+    ]
+    hit_ttfts = [
+        r.ttft_s for r in shared
+        if r.prefix_hit_blocks > 0 and r.ttft_s is not None
+    ]
+    miss_ttfts = [
+        r.ttft_s for r in shared
+        if r.prefix_hit_blocks == 0 and r.ttft_s is not None
+    ]
+    return {
+        "kv_effective_slots": paged_m["peak_active_slots"],
+        "flat_effective_slots": flat_m["peak_active_slots"],
+        "paged_vs_flat_tokens_per_s": round(
+            paged_m["tokens_per_s"]
+            / max(flat_m["tokens_per_s"], 1e-9), 3
+        ),
+        "paged_tokens_per_s": round(paged_m["tokens_per_s"], 1),
+        "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+        "prefix_hits": stats.get("prefix_hits", 0),
+        "prefix_prefill_tokens_saved": int(
+            prefill_flat - prefill_paged
+        ),
+        "prefix_ttft_hit_p50_s": round(_percentile(hit_ttfts, 50), 4),
+        "prefix_ttft_miss_p50_s": round(
+            _percentile(miss_ttfts, 50), 4
+        ),
+        "kv_preemptions": int(
+            paged_reg.get("serving_kv_preemptions_total").value()
+        ),
+        "kv_cow_copies": int(stats.get("cow_copies", 0)),
+        "paged_retraces_after_warmup": retraces,
+        "paged_token_exact": 1,
+        "paged_block_size": block_size,
+    }
 
 
 def main(argv=None):
@@ -199,11 +358,25 @@ def main(argv=None):
     ap.add_argument("--max-len", type=int, default=224)
     ap.add_argument("--prefill-chunk", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
-    ns = ap.parse_args(argv)
-    out = run_bench(
-        slots=ns.slots, n_requests=ns.requests, max_len=ns.max_len,
-        prefill_chunk=ns.prefill_chunk, seed=ns.seed,
+    ap.add_argument(
+        "--prefix-share", type=float, default=None,
+        help="run ONLY the paged-vs-flat A/B with this fraction of "
+        "prompts sharing a system prefix (e.g. 0.6)",
     )
+    ap.add_argument("--block-size", type=int, default=16)
+    ns = ap.parse_args(argv)
+    if ns.prefix_share is not None:
+        out = run_paged_ab(
+            slots=max(2, ns.slots // 2), n_requests=ns.requests,
+            max_len=ns.max_len, prefill_chunk=ns.prefill_chunk,
+            block_size=ns.block_size, seed=ns.seed,
+            prefix_share=ns.prefix_share,
+        )
+    else:
+        out = run_bench(
+            slots=ns.slots, n_requests=ns.requests, max_len=ns.max_len,
+            prefill_chunk=ns.prefill_chunk, seed=ns.seed,
+        )
     print(json.dumps(out))
 
 
