@@ -40,6 +40,7 @@ from dlrover_tpu.autoscaler.signals import (
     FaultHistory,
     SignalBus,
     SignalSnapshot,
+    control_plane_source,
     data_source,
     fault_source,
     fleet_source,
@@ -58,6 +59,7 @@ __all__ = [
     "fleet_source",
     "fault_source",
     "kvpool_source",
+    "control_plane_source",
     "RulePolicy",
     "PolicyConfig",
     "ScaleDecision",

@@ -262,6 +262,33 @@ def kvpool_source(engine) -> Callable[[], Dict[str, object]]:
     return fn
 
 
+def control_plane_source(state_fn) -> Callable[[], Dict[str, object]]:
+    """The master's own saturation (§32) as an autoscaler signal:
+    ``state_fn`` is the servicer's ``control_plane_state``. A policy
+    watching ``shed_level``/``inflight`` rising with world size can
+    stop admitting scale-up before the control plane — not the
+    accelerators — becomes the binding constraint."""
+
+    def fn() -> Dict[str, object]:
+        state = state_fn()
+        overload = state.get("overload", {})
+        rpc = state.get("rpc", {})
+        out: Dict[str, object] = {
+            "shed_level": overload.get("level", 0),
+            "handler_ewma_s": overload.get("handler_ewma_s") or 0.0,
+            "load_factor": overload.get("load_factor", 0.0),
+            "inflight": rpc.get("inflight", 0),
+            "inflight_high_water": rpc.get("inflight_high_water", 0),
+            "rpcs_total": rpc.get("rpcs_total", 0),
+            "cpu_seconds_total": rpc.get("cpu_seconds_total", 0.0),
+        }
+        for cls, count in (overload.get("shed_total") or {}).items():
+            out[f"shed_total.{cls}"] = count
+        return out
+
+    return fn
+
+
 def fault_source(history: FaultHistory) -> Callable[[], Dict[str, object]]:
     """Failure count + observed MTBF (omitted until measurable)."""
 

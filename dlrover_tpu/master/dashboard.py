@@ -161,6 +161,7 @@ class DashboardServer:
         metric_context=None,
         trace_aggregator=None,
         autoscaler=None,
+        control_plane=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
@@ -169,6 +170,10 @@ class DashboardServer:
         self._metric_context = metric_context
         self._trace_aggregator = trace_aggregator
         self._autoscaler = autoscaler
+        # Zero-arg callable (the servicer's control_plane_state):
+        # overload governor state + per-verb RPC telemetry + bounded
+        # buffer occupancy/drops (§32).
+        self._control_plane = control_plane
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self.port = 0
@@ -181,79 +186,60 @@ class DashboardServer:
             def log_message(self, *args):
                 pass
 
+            # Exact-path JSON providers. Each call is guarded in
+            # do_GET: one raising subsystem answers its own endpoint
+            # with a 503 + JSON error body instead of an unhandled
+            # exception (empty reply on the wire), and the OTHER
+            # endpoints keep serving — an incident dashboard must
+            # degrade per-panel, not whole-page.
+            _JSON_ROUTES = {
+                "/api/job": lambda: dashboard._job_detail(),
+                "/api/perf": lambda: dashboard._perf(),
+                "/api/nodes": lambda: dashboard._nodes(),
+                "/api/rdzv": lambda: dashboard._rdzv(),
+                "/api/datasets": lambda: dashboard._datasets(),
+                "/api/phases": lambda: dashboard._phases(),
+                # Live per-rank step-time skew (the autoscaler's and
+                # SRE's "which rank is slow RIGHT NOW" view).
+                "/api/stragglers": lambda: dashboard._stragglers(),
+                # The §30 resource brain: live signal snapshot, recent
+                # decision ledger, dry-run diff.
+                "/api/autoscaler": lambda: dashboard._autoscaler_state(),
+                # The §32 saturation plane: overload governor state,
+                # per-verb RPC telemetry, bounded-buffer occupancy.
+                "/api/control_plane": (
+                    lambda: dashboard._control_plane_state()
+                ),
+            }
+
             def do_GET(self):
                 if self.path == "/" or self.path.startswith("/index"):
                     self._send(200, _PAGE, "text/html")
-                elif self.path == "/api/job":
-                    detail = dashboard._job_detail()
-                    self._send(200, json.dumps(detail), "application/json")
-                elif self.path == "/api/perf":
-                    self._send(
-                        200,
-                        json.dumps(dashboard._perf()),
-                        "application/json",
-                    )
-                elif self.path == "/api/nodes":
-                    self._send(
-                        200,
-                        json.dumps(dashboard._nodes()),
-                        "application/json",
-                    )
-                elif self.path == "/api/rdzv":
-                    self._send(
-                        200,
-                        json.dumps(dashboard._rdzv()),
-                        "application/json",
-                    )
-                elif self.path == "/api/datasets":
-                    self._send(
-                        200,
-                        json.dumps(dashboard._datasets()),
-                        "application/json",
-                    )
+                elif self.path in self._JSON_ROUTES:
+                    self._send_json(self._JSON_ROUTES[self.path])
                 elif self.path == "/metrics":
                     # One Prometheus scrape covers the whole job:
                     # process registry (event-drop counters, phase
                     # second counters, ...) + live goodput/speed + the
                     # per-node daemon aggregates the master scraped.
-                    self._send(
-                        200,
-                        dashboard._metrics_text(),
-                        "text/plain; version=0.0.4",
-                    )
-                elif self.path == "/api/phases":
-                    self._send(
-                        200,
-                        json.dumps(dashboard._phases()),
-                        "application/json",
-                    )
-                elif self.path == "/api/stragglers":
-                    # Live per-rank step-time skew (the autoscaler's and
-                    # SRE's "which rank is slow RIGHT NOW" view).
-                    self._send(
-                        200,
-                        json.dumps(dashboard._stragglers()),
-                        "application/json",
-                    )
-                elif self.path == "/api/autoscaler":
-                    # The §30 resource brain: live signal snapshot,
-                    # recent decision ledger (every entry with the
-                    # signals that triggered it), dry-run diff.
-                    self._send(
-                        200,
-                        json.dumps(dashboard._autoscaler_state()),
-                        "application/json",
-                    )
+                    try:
+                        text = dashboard._metrics_text()
+                    except Exception as e:  # noqa: BLE001 — degrade, don't die
+                        self._send_unavailable(e)
+                        return
+                    self._send(200, text, "text/plain; version=0.0.4")
                 elif self.path.startswith("/api/traces"):
-                    self._send(
-                        200,
-                        json.dumps(dashboard._traces(self.path)),
-                        "application/json",
+                    self._send_json(
+                        lambda: dashboard._traces(self.path)
                     )
                 elif self.path.startswith("/api/node/"):
-                    detail = dashboard._node_detail(
-                        self.path.rsplit("/", 1)[-1]
-                    )
+                    try:
+                        detail = dashboard._node_detail(
+                            self.path.rsplit("/", 1)[-1]
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        self._send_unavailable(e)
+                        return
                     if detail is None:
                         self._send(404, "no such node", "text/plain")
                     else:
@@ -264,6 +250,24 @@ class DashboardServer:
                     self._send(200, _NODE_PAGE, "text/html")
                 else:
                     self._send(404, "not found", "text/plain")
+
+            def _send_json(self, provider):
+                try:
+                    body = json.dumps(provider())
+                except Exception as e:  # noqa: BLE001 — 503, not a dead panel
+                    self._send_unavailable(e)
+                    return
+                self._send(200, body, "application/json")
+
+            def _send_unavailable(self, exc):
+                self._send(
+                    503,
+                    json.dumps({
+                        "error": f"{type(exc).__name__}: {exc}"[:300],
+                        "unavailable": True,
+                    }),
+                    "application/json",
+                )
 
             def _send(self, code, body, ctype):
                 data = body.encode()
@@ -316,7 +320,9 @@ class DashboardServer:
             return {"enabled": True, "error": f"{type(e).__name__}: {e}"}
 
     def _traces(self, path: str):
-        """``/api/traces`` -> recent trace summaries;
+        """``/api/traces`` -> recent trace summaries (+ the
+        aggregator's occupancy/drop accounting — a trace view that
+        hides its own losses overstates coverage);
         ``/api/traces/<trace_id>`` -> that trace's nested span tree."""
         agg = self._trace_aggregator
         if agg is None:
@@ -324,7 +330,18 @@ class DashboardServer:
         tail = path[len("/api/traces"):].strip("/")
         if tail:
             return {"trace_id": tail, "tree": agg.tree(tail)}
-        return {"traces": agg.recent(), "enabled": True}
+        return {
+            "traces": agg.recent(),
+            "enabled": True,
+            "stats": agg.stats(),
+        }
+
+    def _control_plane_state(self):
+        if self._control_plane is None:
+            return {"enabled": False}
+        state = self._control_plane()
+        state["enabled"] = True
+        return state
 
     def _metrics_text(self):
         from dlrover_tpu.observability.prom import master_metrics_text

@@ -264,6 +264,9 @@ class DistributedJobMaster:
                 ),
                 trace_aggregator=self.trace_aggregator,
                 autoscaler=self.autoscaler,
+                # §32: /api/control_plane — overload governor state,
+                # per-verb RPC telemetry, bounded-buffer occupancy.
+                control_plane=self.servicer.control_plane_state,
             )
         self.auto_scaler = None
         if auto_scale:
@@ -309,6 +312,7 @@ class DistributedJobMaster:
             SET_CKPT_INTERVAL,
             SHRINK_WORLD,
             SignalBus,
+            control_plane_source,
             data_source,
             fault_source,
             fleet_source,
@@ -353,6 +357,12 @@ class DistributedJobMaster:
             .add_source("fleet", fleet_source())
             .add_source("fault", fault_source(history))
             .add_source("ckpt", self.ckpt_cadence.as_source())
+            # §32: the master's own saturation — a policy can refuse
+            # scale-up when the control plane, not the accelerators,
+            # is the binding constraint.
+            .add_source("control_plane", control_plane_source(
+                self.servicer.control_plane_state
+            ))
             .add_source("world", lambda: {
                 "size": len(
                     self.job_manager.worker_manager.alive_nodes()

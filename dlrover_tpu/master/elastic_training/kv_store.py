@@ -19,9 +19,19 @@ DEFAULT_WAIT_TIMEOUT_S = 300.0
 def _kv_metrics():
     from dlrover_tpu.observability.registry import default_registry
 
-    return default_registry().counter(
-        "kv_wait_expired_total",
-        "bounded KV-store waits that expired before all keys arrived",
+    reg = default_registry()
+    return (
+        reg.counter(
+            "kv_wait_expired_total",
+            "bounded KV-store waits that expired before all keys arrived",
+        ),
+        # §32 wait-depth gauge: servicer threads parked inside wait()
+        # RIGHT NOW — at fleet scale a stuck producer shows up here
+        # before it shows up as thread-pool exhaustion.
+        reg.gauge(
+            "kv_wait_depth",
+            "threads currently blocked in a KV-store wait",
+        ),
     )
 
 
@@ -30,7 +40,7 @@ class KVStoreService:
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._wait_expired = _kv_metrics()
+        self._wait_expired, self._wait_depth = _kv_metrics()
 
     def set(self, key: str, value: bytes):
         with self._cond:
@@ -57,14 +67,22 @@ class KVStoreService:
         self, keys: List[str], timeout: float = DEFAULT_WAIT_TIMEOUT_S
     ) -> bool:
         deadline = time.time() + max(timeout, 0.0)
-        with self._cond:
-            while not all(k in self._store for k in keys):
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    self._wait_expired.inc()
-                    return False
-                self._cond.wait(remaining)
-            return True
+        self._wait_depth.inc()
+        try:
+            with self._cond:
+                while not all(k in self._store for k in keys):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        self._wait_expired.inc()
+                        return False
+                    self._cond.wait(remaining)
+                return True
+        finally:
+            self._wait_depth.dec()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._store)
 
     def delete(self, key: str):
         with self._lock:

@@ -74,6 +74,14 @@ def _rdzv_metrics():
             "first join of a round to round completion",
             labelnames=("rdzv",),
         ),
+        # World size of the latest completed round, next to the quorum
+        # histogram so "time-to-quorum vs world size" reads off one
+        # scrape (§32: the load harness sweeps {8,64,256,1024}).
+        "world": reg.gauge(
+            "rdzv_world_size",
+            "node count of the latest completed world",
+            labelnames=("rdzv",),
+        ),
     }
 
 
@@ -142,6 +150,9 @@ class RendezvousManager(ABC):
         into the completed world."""
         self._metrics["rounds"].inc(rdzv=self.name)
         self._metrics["waiting"].set(len(self._waiting), rdzv=self.name)
+        self._metrics["world"].set(
+            len(self._latest_world), rdzv=self.name
+        )
         if self._round_start_time > 0:
             self._metrics["quorum"].observe(
                 max(time.time() - self._round_start_time, 0.0),
@@ -400,8 +411,11 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     world = {w.node_rank: w.local_world_size for w in chosen}
                     for w in chosen:
                         del self._waiting[w.node_rank]
-                    self._record_round_completed()
+                    # World BEFORE the completion record (training-
+                    # manager ordering): the rdzv_world_size gauge
+                    # must describe the round that just formed.
                     self._latest_world = dict(sorted(world.items()))
+                    self._record_round_completed()
                     self._node_groups = self._group_nodes(
                         self._check_round, self._latest_world
                     )

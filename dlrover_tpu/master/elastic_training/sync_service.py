@@ -22,9 +22,16 @@ def _sync_metrics():
     from dlrover_tpu.observability.registry import default_registry
 
     reg = default_registry()
-    return reg.counter(
-        "sync_wait_expired_total",
-        "bounded sync-barrier waits that expired before completion",
+    return (
+        reg.counter(
+            "sync_wait_expired_total",
+            "bounded sync-barrier waits that expired before completion",
+        ),
+        # §32 wait-depth gauge, same rationale as kv_wait_depth.
+        reg.gauge(
+            "sync_wait_depth",
+            "threads currently blocked in a sync-barrier wait",
+        ),
     )
 
 
@@ -34,7 +41,7 @@ class SyncService:
         self._cond = threading.Condition(self._lock)
         self._syncs: Dict[str, Set[int]] = {}
         self._finished: Set[str] = set()
-        self._wait_expired = _sync_metrics()
+        self._wait_expired, self._wait_depth = _sync_metrics()
 
     def join_sync(self, sync_name: str, node_rank: int) -> bool:
         with self._cond:
@@ -62,14 +69,18 @@ class SyncService:
         # wait budget and can push the barrier into its timeout path.
         deadline = time.time() + max(timeout, 0.0)
         fault_point("sync.wait", sync=sync_name)
-        with self._cond:
-            while sync_name not in self._finished:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    self._wait_expired.inc()
-                    return False
-                self._cond.wait(remaining)
-            return True
+        self._wait_depth.inc()
+        try:
+            with self._cond:
+                while sync_name not in self._finished:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        self._wait_expired.inc()
+                        return False
+                    self._cond.wait(remaining)
+                return True
+        finally:
+            self._wait_depth.dec()
 
     def members(self, sync_name: str) -> Set[int]:
         with self._lock:

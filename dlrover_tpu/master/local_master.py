@@ -105,6 +105,7 @@ class LocalJobMaster:
                 FaultHistory,
                 SET_CKPT_INTERVAL,
                 SignalBus,
+                control_plane_source,
                 data_source,
                 fault_source,
                 fleet_source,
@@ -123,6 +124,10 @@ class LocalJobMaster:
                 .add_source("fleet", fleet_source())
                 .add_source("fault", fault_source(self.fault_history))
                 .add_source("ckpt", self.ckpt_cadence.as_source())
+                # §32 master saturation signal.
+                .add_source("control_plane", control_plane_source(
+                    self.servicer.control_plane_state
+                ))
             )
 
             def evict(decision):

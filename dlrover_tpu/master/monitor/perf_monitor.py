@@ -42,7 +42,16 @@ class PerfMonitor:
         # step_time_s piggyback on GlobalStepReport.
         self._rank_step_ewma: Dict[int, float] = {}
         self._rank_step_reports: Dict[int, int] = {}
-        self._last_gauge_refresh = 0.0
+        # §32: the gauge path is O(1) per report. A running median
+        # ESTIMATOR (sign-step with a multiplicative delta, FAME-style)
+        # tracks the fleet median incrementally; only the reporting
+        # rank's gauge is refreshed per report; an exact resync runs
+        # every ~R reports so estimator drift is bounded (amortized
+        # O(log R) per report). straggler_report() stays an exact
+        # recompute — its output is the contract.
+        self._median_est = 0.0
+        self._median_delta = 0.0
+        self._reports_since_sync = 0
         registry = default_registry()
         self._phase_secs_counter = registry.counter(
             "dlrover_goodput_phase_seconds_total",
@@ -82,16 +91,50 @@ class PerfMonitor:
                 self._total_train_secs += elapsed_train_secs
             if node_id >= 0 and step_time_s > 0:
                 prev = self._rank_step_ewma.get(node_id)
-                self._rank_step_ewma[node_id] = (
+                ewma = (
                     step_time_s if prev is None
                     else 0.3 * step_time_s + 0.7 * prev
                 )
+                self._rank_step_ewma[node_id] = ewma
                 self._rank_step_reports[node_id] = (
                     self._rank_step_reports.get(node_id, 0) + 1
                 )
+                gauge_score, resync = self._incremental_median_locked(ewma)
         self._step_reports_counter.inc()
         if node_id >= 0 and step_time_s > 0:
-            self._update_straggler_gauges()
+            # O(1) per report: only THIS rank's gauge moves, scored
+            # against the running median estimate — the old path
+            # recomputed the full O(R log R) report per gauge window.
+            self._straggler_gauge.set(gauge_score, rank=str(node_id))
+            if resync:
+                # Amortized exact resync (~every R reports): bounds
+                # estimator drift at O(log R) amortized per report.
+                self._update_straggler_gauges()
+
+    def _incremental_median_locked(self, ewma: float):
+        """FAME-style running median: step the estimate toward each new
+        observation by a delta that halves when the observation lands
+        within delta of the estimate. O(1); called under ``_lock``.
+        Returns (score-for-this-rank, exact-resync-due)."""
+        if self._median_est <= 0.0:
+            self._median_est = ewma
+            self._median_delta = max(ewma / 2.0, 1e-9)
+        else:
+            if ewma > self._median_est:
+                self._median_est += self._median_delta
+            elif ewma < self._median_est:
+                self._median_est -= self._median_delta
+            if abs(ewma - self._median_est) < self._median_delta:
+                self._median_delta = max(
+                    self._median_delta / 2.0, self._median_est * 1e-3
+                )
+        self._reports_since_sync += 1
+        resync = self._reports_since_sync >= max(
+            len(self._rank_step_ewma), 32
+        )
+        if resync:
+            self._reports_since_sync = 0
+        return ewma / max(self._median_est, 1e-9), resync
 
     # ---- straggler score ---------------------------------------------------
 
@@ -153,20 +196,22 @@ class PerfMonitor:
             "threshold": threshold,
         }
 
-    # Full-report recompute is O(R log R); refreshing it on EVERY rank's
-    # report would make the RPC handler O(R^2 log R) per cadence at
-    # fleet scale. One refresh per window keeps the gauge live without
-    # taxing the handler; /api/stragglers always computes fresh.
-    GAUGE_REFRESH_S = 1.0
-
     def _update_straggler_gauges(self):
-        now = time.time()
-        with self._lock:
-            if now - self._last_gauge_refresh < self.GAUGE_REFRESH_S:
-                return
-            self._last_gauge_refresh = now
-        for rank, info in self.straggler_report()["ranks"].items():
+        """Exact gauge resync from a full straggler_report() — no
+        longer on the per-report hot path (§32 replaced the old
+        throttled full recompute with the O(1) incremental estimator);
+        runs amortized every ~R reports, on explicit demand, and keeps
+        the estimator honest by re-anchoring it to the true median."""
+        report = self.straggler_report()
+        for rank, info in report["ranks"].items():
             self._straggler_gauge.set(info["score"], rank=str(rank))
+        median = report["median_step_time_s"]
+        if median > 0:
+            with self._lock:
+                self._median_est = median
+                self._median_delta = max(
+                    self._median_delta, median * 1e-3
+                )
 
     def reset_rank(self, rank: int):
         """Forget one rank's step-time history — called when the seat's
@@ -244,6 +289,19 @@ class PerfMonitor:
             return {phase: 0.0 for phase in totals}
         return {phase: secs / grand for phase, secs in totals.items()}
 
+    def buffer_stats(self) -> Dict:
+        """§32 bounded-buffer accounting for /api/control_plane: the
+        phase-record ring's occupancy + drops without copying the
+        records themselves (phase_records() copies; this is the cheap
+        saturation view)."""
+        with self._lock:
+            return {
+                "occupancy": len(self._phase_records),
+                "capacity": self._phase_records.maxlen,
+                "drops": self._phase_records_dropped,
+                "ranks_tracked": len(self._rank_step_ewma),
+            }
+
     def phase_records(self) -> Dict:
         """The raw goodput ledger for the timeline merger: the recorded
         (node, phase, start, end) intervals plus the accounting origin,
@@ -271,3 +329,6 @@ class PerfMonitor:
             self._max_phase_end = 0.0
             self._rank_step_ewma.clear()
             self._rank_step_reports.clear()
+            self._median_est = 0.0
+            self._median_delta = 0.0
+            self._reports_since_sync = 0

@@ -26,6 +26,8 @@ from dlrover_tpu.master.elastic_training.rdzv_manager import (
 )
 from dlrover_tpu.master.elastic_training.sync_service import SyncService
 from dlrover_tpu.fault import fault_point
+from dlrover_tpu.master.overload import OverloadGovernor
+from dlrover_tpu.master.rpc_metrics import RpcTelemetry, clocks
 from dlrover_tpu.observability import tracing
 from dlrover_tpu.rpc.transport import MasterService
 
@@ -44,6 +46,7 @@ class MasterServicer(MasterService):
         elastic_ps_service: Optional[ClusterVersionService] = None,
         rescale_coordinator=None,
         trace_aggregator=None,
+        overload_governor: Optional[OverloadGovernor] = None,
     ):
         self._rescale_coordinator = rescale_coordinator
         # Recent trace trees served at /api/traces: fed by workers
@@ -127,69 +130,175 @@ class MasterServicer(MasterService):
             comm.RescaleJoinReport: self._report_rescale_join,
             comm.RescaleAckReport: self._report_rescale_ack,
         }
+        # §32 per-verb telemetry + overload accounting. The verb label
+        # set is exactly the registered handler types (+ the "other"
+        # collapse bucket), so exposition cardinality is bounded by
+        # construction no matter what arrives on the wire.
+        self._telemetry = RpcTelemetry(
+            t.__name__
+            for t in (
+                list(self._get_handlers) + list(self._report_handlers)
+            )
+        )
+        self._overload = overload_governor or OverloadGovernor()
 
     # ---- transport entry points -------------------------------------------
 
     def node_last_contact(self) -> Dict[int, float]:
         return dict(self._node_last_contact)
 
+    @property
+    def telemetry(self) -> RpcTelemetry:
+        return self._telemetry
+
+    @property
+    def overload_governor(self) -> OverloadGovernor:
+        return self._overload
+
     def get(self, message: Message) -> Message:
-        self._node_last_contact[message.node_id] = time.time()
-        request = (
-            comm.BaseRequest.deserialize(message.data)
-            if message.data
-            else comm.BaseRequest()
-        )
-        handler = self._get_handlers.get(type(request))
-        if handler is None:
-            response = comm.BaseResponse(
-                success=False, reason=f"no get handler for {type(request)}"
-            )
-        else:
-            # Server span parented to the caller's envelope context:
-            # the worker's client RPC span and this handler span share
-            # one trace. Disarmed: one global check, a no-op object.
-            with tracing.server_span(
-                f"master.{type(request).__name__}",
-                getattr(message, "trace", None),
-                node_id=message.node_id,
-            ):
-                response = handler(message, request)
+        reply, name = self._dispatch(message, self._get_handlers, "get")
         # AFTER the handler: any state mutation (lease moved to doing,
         # kv value read) already happened — dropping the reply here is
         # the "response lost on the wire" fault the client-side retry
         # and the master's timeout recovery must absorb.
-        fault_point(
-            "rpc.get.drop_reply", request=type(request).__name__
-        )
-        return Message(node_id=message.node_id, data=response.serialize())
+        fault_point("rpc.get.drop_reply", request=name)
+        return reply
 
     def report(self, message: Message) -> Message:
+        reply, name = self._dispatch(
+            message, self._report_handlers, "report"
+        )
+        # State already applied; a dropped reply makes the client
+        # re-send — report handlers must stay safe to re-apply
+        # (at-most-once effect), which the chaos soak asserts.
+        fault_point("rpc.report.drop_reply", request=name)
+        return reply
+
+    def _dispatch(
+        self, message: Message, handlers, kind: str
+    ) -> "tuple":
+        """One instrumented dispatch: deserialize → admission → handler
+        → serialize, with the §32 split timed so lock contention shows
+        up as handler time, and the server span covering the SAME
+        window as ``master_rpc_seconds`` (the soak asserts they agree
+        within 15%). Returns ``(reply, request_type_name)`` — the
+        caller fires its drop_reply fault point (a literal site the
+        taxonomy test greps for) before handing the reply to the
+        transport."""
         self._node_last_contact[message.node_id] = time.time()
+        wall0 = time.time()
+        t0, cpu0 = clocks()
         request = (
             comm.BaseRequest.deserialize(message.data)
             if message.data
             else comm.BaseRequest()
         )
-        handler = self._report_handlers.get(type(request))
-        if handler is None:
-            response = comm.BaseResponse(
-                success=False, reason=f"no report handler for {type(request)}"
+        t_deser = time.monotonic()
+        name = type(request).__name__
+        tm = self._telemetry
+        verb = tm.verb(name)
+        tm.begin(verb)
+        error_kind = None
+        dropped = False
+        handler_s = None  # stays None when the handler never runs
+        serialize_s = 0.0
+        try:
+            handler = handlers.get(type(request))
+            shed_class = (
+                self._overload.admit(name) if handler is not None else None
             )
-        else:
-            with tracing.server_span(
-                f"master.{type(request).__name__}",
-                getattr(message, "trace", None),
-                node_id=message.node_id,
-            ):
-                response = handler(message, request)
-        # State already applied; a dropped reply makes the client re-send
-        # — report handlers must stay safe to re-apply (at-most-once
-        # effect), which the chaos soak asserts.
-        fault_point(
-            "rpc.report.drop_reply", request=type(request).__name__
-        )
-        return Message(node_id=message.node_id, data=response.serialize())
+            if handler is not None and shed_class is None:
+                # Server span parented to the caller's envelope
+                # context: the worker's client RPC span and this
+                # handler span share one trace. Disarmed: one global
+                # check, a no-op object. Back-dated to the
+                # pre-deserialize clock and exited after serialize, so
+                # span duration == master_rpc_seconds duration (the
+                # soak's 15%-agreement invariant).
+                span = tracing.server_span(
+                    f"master.{name}",
+                    getattr(message, "trace", None),
+                    start_mono=t0,
+                    start_wall=wall0,
+                    node_id=message.node_id,
+                )
+            else:
+                span = tracing.NOOP_SPAN
+            with span:
+                if handler is None:
+                    error_kind = "no_handler"
+                    response = comm.BaseResponse(
+                        success=False,
+                        reason=f"no {kind} handler for {type(request)}",
+                    )
+                elif shed_class is not None:
+                    # Graceful degradation: answered, not handled. Only
+                    # diagnostic/telemetry classes can reach here — the
+                    # governor admits critical verbs unconditionally.
+                    dropped = True
+                    response = comm.BaseResponse(
+                        success=False,
+                        reason=f"overload: shed {shed_class} traffic",
+                    )
+                else:
+                    th0 = time.monotonic()
+                    try:
+                        response = handler(message, request)
+                    except Exception as e:
+                        error_kind = type(e).__name__
+                        raise
+                    finally:
+                        handler_s = time.monotonic() - th0
+                        self._overload.observe(
+                            handler_s, tm.inflight_now()
+                        )
+                ts0 = time.monotonic()
+                reply = Message(
+                    node_id=message.node_id, data=response.serialize()
+                )
+                serialize_s = time.monotonic() - ts0
+        finally:
+            t_end, cpu_end = clocks()
+            tm.end(
+                verb,
+                total_s=t_end - t0,
+                deserialize_s=t_deser - t0,
+                handler_s=handler_s,
+                serialize_s=serialize_s,
+                cpu_s=max(cpu_end - cpu0, 0.0),
+                error_kind=error_kind,
+                dropped=dropped,
+            )
+        return reply, name
+
+    def control_plane_state(self) -> Dict:
+        """The §32 saturation view behind ``/api/control_plane``:
+        overload governor state, per-verb RPC telemetry, and every
+        bounded buffer's occupancy + drop counters."""
+        buffers: Dict[str, Dict] = {}
+        if self._trace_aggregator is not None:
+            buffers["trace_aggregator"] = self._trace_aggregator.stats()
+        if self._perf_monitor is not None:
+            stats = getattr(self._perf_monitor, "buffer_stats", None)
+            if callable(stats):
+                buffers["perf_phase_records"] = stats()
+        if self._task_manager is not None:
+            stats = getattr(self._task_manager, "queue_stats", None)
+            if callable(stats):
+                buffers["task_queues"] = stats()
+        size = getattr(self._kv_store, "size", None)
+        if callable(size):
+            buffers["kv_store"] = {
+                "occupancy": size(),
+                "drops": 0,  # unbounded dict today; 0 by definition
+            }
+        return {
+            "overload": self._overload.state(),
+            "rpc": self._telemetry.summary(),
+            "buffers": buffers,
+            "nodes_seen": len(self._node_last_contact),
+            "uptime_s": round(time.time() - self._start_time, 3),
+        }
 
     # ---- rendezvous --------------------------------------------------------
 

@@ -30,10 +30,41 @@ class Task:
     task_type: str
     shard: Shard
     epoch: int = 0
+    # Wall time this task (re-)entered the todo queue; 0 = unknown.
+    # Dispatch observes now - enqueue_ts as the §32 queue-age
+    # histogram — a growing age at constant depth means dispatch is
+    # starved, not the dataset.
+    enqueue_ts: float = 0.0
 
     @classmethod
     def create_invalid_task(cls) -> "Task":
         return cls(-1, TaskType.NONE, Shard("", 0, 0))
+
+
+def _queue_metrics():
+    """§32 dispatch self-instrumentation (fresh registry lookup per
+    manager instance, same discipline as rdzv_manager's)."""
+    from dlrover_tpu.master.rpc_metrics import RPC_BUCKETS
+    from dlrover_tpu.observability.registry import default_registry
+
+    reg = default_registry()
+    return {
+        "dispatch": reg.histogram(
+            "shard_dispatch_seconds",
+            "time spent inside one get-task(s) dispatch call",
+            buckets=RPC_BUCKETS,
+        ),
+        "queue_age": reg.histogram(
+            "shard_task_queue_age_seconds",
+            "todo-queue residence time of a lease at dispatch",
+        ),
+        "todo": reg.gauge(
+            "shard_todo_depth", "queued shard leases across datasets"
+        ),
+        "doing": reg.gauge(
+            "shard_doing_depth", "in-flight shard leases across datasets"
+        ),
+    }
 
 
 @dataclass
@@ -73,6 +104,7 @@ class BatchDatasetManager:
         self._task_id_seq = 0
         self._completed_count = 0
         self._lock = threading.Lock()
+        self._metrics = _queue_metrics()
 
     def get_task(self, node_id: int) -> Task:
         with self._lock:
@@ -88,7 +120,12 @@ class BatchDatasetManager:
                 return Task(-1, TaskType.WAIT, Shard("", 0, 0))
             return Task.create_invalid_task()
         task = self.todo.popleft()
-        self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
+        now = time.time()
+        if task.enqueue_ts > 0:
+            self._metrics["queue_age"].observe(
+                max(now - task.enqueue_ts, 0.0)
+            )
+        self.doing[task.task_id] = _DoingTask(task, node_id, now)
         return task
 
     def get_tasks(self, node_id: int, count: int) -> List[Task]:
@@ -102,9 +139,13 @@ class BatchDatasetManager:
     def _create_todo_tasks(self):
         shards = self._splitter.create_shards()
         epoch = self._splitter.epoch
+        now = time.time()
         for shard in shards:
             self.todo.append(
-                Task(self._task_id_seq, self._task_type, shard, epoch)
+                Task(
+                    self._task_id_seq, self._task_type, shard, epoch,
+                    enqueue_ts=now,
+                )
             )
             self._task_id_seq += 1
 
@@ -123,6 +164,7 @@ class BatchDatasetManager:
                     task_id,
                     node_id,
                 )
+                doing.task.enqueue_ts = time.time()
                 self.todo.appendleft(doing.task)
                 return False
             self._completed_count += 1
@@ -143,6 +185,7 @@ class BatchDatasetManager:
                     tid,
                     doing.node_id,
                 )
+                doing.task.enqueue_ts = now
                 self.todo.appendleft(doing.task)
 
     def recover_node_tasks(self, node_id: int):
@@ -152,8 +195,11 @@ class BatchDatasetManager:
             lost = [
                 tid for tid, d in self.doing.items() if d.node_id == node_id
             ]
+            now = time.time()
             for tid in lost:
-                self.todo.appendleft(self.doing.pop(tid).task)
+                task = self.doing.pop(tid).task
+                task.enqueue_ts = now
+                self.todo.appendleft(task)
 
     def completed(self) -> bool:
         with self._lock:
@@ -186,6 +232,7 @@ class BatchDatasetManager:
             self.doing.clear()
             self._splitter.epoch = state.get("epoch", 0)
             self._completed_count = state.get("completed", 0)
+            now = time.time()
             for entry in state.get("undone_shards", []):
                 start, end = entry[0], entry[1]
                 indices = entry[2] if len(entry) > 2 else None
@@ -195,6 +242,7 @@ class BatchDatasetManager:
                         self._task_type,
                         Shard(dataset_name, start, end, indices),
                         self._splitter.epoch,
+                        enqueue_ts=now,
                     )
                 )
                 self._task_id_seq += 1
@@ -229,6 +277,7 @@ class TaskManager:
             "shard_tasks_recovered_total",
             "in-flight leases re-queued after timeout/failure/node loss",
         )
+        self._metrics = _queue_metrics()
 
     def start(self):
         if self._thread is None:
@@ -301,10 +350,13 @@ class TaskManager:
         mgr = self.get_dataset(dataset_name)
         if mgr is None:
             return comm.ShardTask()
+        t0 = time.monotonic()
         task = mgr.get_task(node_id)
+        self._metrics["dispatch"].observe(time.monotonic() - t0)
         self._dispatch_rpcs.inc()
         if task.task_id >= 0:
             self._tasks_dispatched.inc()
+        self._refresh_depth_gauges()
         return self._to_shard_task(task, dataset_name)
 
     def get_tasks(
@@ -316,6 +368,7 @@ class TaskManager:
         mgr = self.get_dataset(dataset_name)
         if mgr is None:
             return [comm.ShardTask()]
+        t0 = time.monotonic()
         getter = getattr(mgr, "get_tasks", None)
         if getter is not None:
             tasks = getter(node_id, count)
@@ -323,10 +376,12 @@ class TaskManager:
             # Duck-typed manager without the batched verb: same sentinel
             # contract, one lock acquisition per task.
             tasks = drain_tasks(mgr.get_task, node_id, count)
+        self._metrics["dispatch"].observe(time.monotonic() - t0)
         self._dispatch_rpcs.inc()
         self._tasks_dispatched.inc(
             sum(1 for t in tasks if t.task_id >= 0) or 0
         )
+        self._refresh_depth_gauges()
         return [self._to_shard_task(t, dataset_name) for t in tasks]
 
     def report_task_done(
@@ -363,6 +418,37 @@ class TaskManager:
             before = len(m.doing)
             m.recover_node_tasks(node_id)
             self._tasks_recovered.inc(max(before - len(m.doing), 0))
+
+    def _refresh_depth_gauges(self):
+        """Depth gauges after a dispatch; len() per manager under the
+        GIL, no manager locks taken — gauges tolerate a ±1 race."""
+        with self._lock:
+            managers = list(self._datasets.values())
+        self._metrics["todo"].set(sum(len(m.todo) for m in managers))
+        self._metrics["doing"].set(sum(len(m.doing) for m in managers))
+
+    def queue_stats(self) -> Dict[str, object]:
+        """§32 buffer accounting for /api/control_plane: occupancy +
+        drops for the lease queues (leases are never dropped — they are
+        re-queued, and the recovery counter is the honest analogue)."""
+        with self._lock:
+            datasets = dict(self._datasets)
+        per = {
+            name: {"todo": len(m.todo), "doing": len(m.doing)}
+            for name, m in datasets.items()
+        }
+        todo = sum(d["todo"] for d in per.values())
+        doing = sum(d["doing"] for d in per.values())
+        dispatch = self._metrics["dispatch"]
+        return {
+            "occupancy": todo + doing,
+            "drops": 0,
+            "todo": todo,
+            "doing": doing,
+            "recovered_total": self._tasks_recovered.value(),
+            "dispatch_p99_s": dispatch.quantile(0.99),
+            "datasets": per,
+        }
 
     def finished(self) -> bool:
         with self._lock:

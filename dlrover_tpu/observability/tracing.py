@@ -243,12 +243,23 @@ class Tracer:
         kind: str = "internal",
         parent=None,
         attrs: Optional[Dict] = None,
+        start_mono: Optional[float] = None,
+        start_wall: Optional[float] = None,
     ) -> Span:
         """A live span. ``parent`` may be a :class:`Span`, a carrier
         dict from another process, or None — None parents to this
-        thread's active span, or starts a fresh trace."""
+        thread's active span, or starts a fresh trace.
+
+        ``start_mono``/``start_wall`` back-date the span to timestamps
+        taken before it could be named (the servicer clocks dispatch
+        BEFORE deserializing the request that names the span — §32's
+        metric-vs-span agreement depends on both covering the same
+        window)."""
         trace_id, parent_id = self._resolve_parent(parent)
-        return Span(self, name, kind, trace_id, parent_id, attrs)
+        return Span(
+            self, name, kind, trace_id, parent_id, attrs,
+            start_mono=start_mono, start_wall=start_wall,
+        )
 
     def record_span(
         self,
@@ -418,15 +429,18 @@ def span(name: str, kind: str = "internal", parent=None, **attrs):
                              attrs=attrs or None)
 
 
-def server_span(name: str, carrier, **attrs):
+def server_span(name: str, carrier, start_mono=None, start_wall=None,
+                **attrs):
     """A server-side span parented to a remote carrier (or a fresh
-    trace when the caller sent none)."""
+    trace when the caller sent none). ``start_mono``/``start_wall``
+    optionally back-date it to pre-deserialize dispatch clocks."""
     tracer = _tracer
     if tracer is None:
         return NOOP_SPAN
     parent = carrier if isinstance(carrier, dict) else None
     return tracer.start_span(name, kind="server", parent=parent,
-                             attrs=attrs or None)
+                             attrs=attrs or None,
+                             start_mono=start_mono, start_wall=start_wall)
 
 
 def current_carrier() -> Optional[Dict[str, str]]:
@@ -480,6 +494,17 @@ class TraceAggregator:
         # trace_id -> list of span records, insertion-ordered dict as an
         # LRU-by-arrival of traces.
         self._traces: "Dict[str, List[Dict]]" = {}
+        # Cap overflows are BOUNDED behavior, not silent behavior: every
+        # span lost to trace eviction or a full per-trace bucket is
+        # counted, locally and on /metrics (§32 buffer-accounting law).
+        self._dropped = {"trace_cap": 0, "span_cap": 0}
+        from dlrover_tpu.observability.registry import default_registry
+
+        self._dropped_counter = default_registry().counter(
+            "trace_ingest_dropped_total",
+            "spans lost at the master's trace aggregator caps",
+            labelnames=("reason",),
+        )
 
     def ingest(self, spans: Iterable[Dict]):
         with self._lock:
@@ -493,12 +518,40 @@ class TraceAggregator:
                 if bucket is None:
                     bucket = self._traces[trace_id] = []
                     while len(self._traces) > self._max_traces:
-                        self._traces.pop(next(iter(self._traces)))
+                        evicted = self._traces.pop(
+                            next(iter(self._traces))
+                        )
+                        if evicted:
+                            self._dropped["trace_cap"] += len(evicted)
+                            self._dropped_counter.inc(
+                                len(evicted), reason="trace_cap"
+                            )
                 if len(bucket) < self._max_spans:
                     bucket.append(dict(record))
+                else:
+                    self._dropped["span_cap"] += 1
+                    self._dropped_counter.inc(reason="span_cap")
 
     def ingest_one(self, record: Dict):
         self.ingest((record,))
+
+    def stats(self) -> Dict:
+        """Occupancy + drop accounting for /api/traces and
+        /api/control_plane: a bounded buffer that cannot report its
+        occupancy and drops is indistinguishable from a lossless one."""
+        with self._lock:
+            spans = sum(len(b) for b in self._traces.values())
+            return {
+                # Normalized occupancy/drops keys: every bounded
+                # buffer on /api/control_plane speaks the same schema.
+                "occupancy": spans,
+                "drops": sum(self._dropped.values()),
+                "traces": len(self._traces),
+                "spans": spans,
+                "max_traces": self._max_traces,
+                "max_spans_per_trace": self._max_spans,
+                "dropped": dict(self._dropped),
+            }
 
     def trace_ids(self) -> List[str]:
         with self._lock:

@@ -209,10 +209,19 @@ class _HttpHandler(BaseHTTPRequestHandler):
             self.send_error(500)
 
 
+class _FleetHTTPServer(ThreadingHTTPServer):
+    # The stdlib default listen backlog is 5: a fleet of workers (or a
+    # rendezvous storm of 1k joiners) opening connections together gets
+    # its SYNs dropped and the clients burn ~1s retry backoffs — the
+    # §32 load harness measured exactly that. 128 rides the kernel's
+    # somaxconn clamp.
+    request_queue_size = 128
+
+
 class HttpMasterServer:
     def __init__(self, port: int, service: MasterService):
         handler = type("BoundHandler", (_HttpHandler,), {"service": service})
-        self._httpd = ThreadingHTTPServer(("", port), handler)
+        self._httpd = _FleetHTTPServer(("", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -63,3 +63,10 @@ def pytest_configure(config):
         "kvpool: paged KV memory plane (block-table cache, prefix "
         "reuse, COW, SLO-class admission) — docs/DESIGN.md §31",
     )
+    config.addinivalue_line(
+        "markers",
+        "control_plane: master saturation plane (per-verb RPC "
+        "telemetry, overload shed law, sim load harness) — "
+        "docs/DESIGN.md §32; fast lane runs the 64-worker smoke, the "
+        "1k-worker ramp is slow-lane",
+    )

@@ -10,6 +10,12 @@ tracing`` (``DLROVER_TPU_TRACE_FILE``, the fleet soak's
     # per-span-name latency table (count / mean / p50 / p95 / max)
     python tools/trace_query.py --summary spans_*.jsonl
 
+    # master control-plane verbs only: master.<RequestType> server
+    # spans folded into the same table, one row per verb — the span
+    # mirror of /metrics' master_rpc_seconds{verb} (§32), for
+    # cross-checking metrics against traces
+    python tools/trace_query.py --verbs spans_master.jsonl
+
     # one trace's tree + critical path
     python tools/trace_query.py --trace 7f3a... spans_*.jsonl
 
@@ -50,6 +56,20 @@ def slowest(spans: List[Dict], top: int = 10,
     ]
     pool.sort(key=lambda s: -s["dur_s"])
     return pool[:top]
+
+
+def verb_summary(spans: List[Dict]) -> List[Dict]:
+    """The §32 per-verb table from ``master.<RequestType>`` server
+    spans: same columns as :func:`summarize`, the ``master.`` prefix
+    stripped so rows line up with ``master_rpc_seconds{verb}``
+    label values when cross-checking metrics against spans."""
+    rows = summarize([
+        {**s, "name": s.get("name", "")[len("master."):]}
+        for s in spans
+        if s.get("name", "").startswith("master.")
+        and s.get("kind") == "server"
+    ])
+    return rows
 
 
 def summarize(spans: List[Dict]) -> List[Dict]:
@@ -132,6 +152,9 @@ def main(argv=None) -> int:
     ap.add_argument("--name", help="filter spans by name")
     ap.add_argument("--summary", action="store_true",
                     help="per-name latency table")
+    ap.add_argument("--verbs", action="store_true",
+                    help="per-verb latency table from master.<verb> "
+                    "server spans (cross-check vs master_rpc_seconds)")
     ap.add_argument("--trace",
                     help="print one trace's tree + critical path")
     ap.add_argument("--json", action="store_true",
@@ -160,8 +183,11 @@ def main(argv=None) -> int:
             )
         return 0
 
-    if ns.summary:
-        rows = summarize(spans)
+    if ns.summary or ns.verbs:
+        rows = verb_summary(spans) if ns.verbs else summarize(spans)
+        if ns.verbs and not rows:
+            print("no master.<verb> server spans found", file=sys.stderr)
+            return 1
         if ns.json:
             print(json.dumps(rows))
             return 0
