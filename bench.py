@@ -317,6 +317,14 @@ def ce_ab_phase(out=None):
         "ce_fused_chunked_vs_dense": round(tc / td, 3),
         "ce_fused_logits_bytes_saved_mb": round(n * v * 4 / 1e6),
     })
+    # Crossover-pin recheck (§33 satellite): the fresh ratio must
+    # agree with the AUTO_FUSED_MIN_NV pin's side for this shape —
+    # chunked slower than dense exactly when auto prefers dense. A
+    # drifted crossover shows up as ce_auto_pin_consistent=0 in the
+    # artifact instead of silently mis-routing resolve_ce_path.
+    out["ce_auto_pin_consistent"] = int(
+        (tc / td >= 1.0) == _fce.auto_prefers_dense(n, v)
+    )
     tf = _timed_op(grad_chain(pallas), x, 30, overhead)
     out["ce_fused_pallas_ms"] = round(tf * 1e3, 2)
     return out
@@ -333,7 +341,15 @@ def ring_inner_ab_phase(out=None):
     einsum path materializes the [h, s, s] f32 logits (8 GB at s=16k),
     the flash path streams tiles through VMEM. Single-chip measurable —
     the ring's ppermute hops need a real sp mesh, but the inner block is
-    where the memory/bandwidth win lives."""
+    where the memory/bandwidth win lives.
+
+    Workload is sized to the phase budget (the BENCH_SELF round
+    recorded "exceeded its 113s slice" at fixed iteration counts):
+    each remaining measurement gets an equal share of the slice, the
+    iteration count derives from the previous size's per-iter time
+    (~4x per sequence doubling), and measurements that cannot fit even
+    a minimal run are SKIPPED with a marker — partial results, never a
+    timeout sentinel."""
     import jax
     import jax.numpy as jnp
 
@@ -342,14 +358,19 @@ def ring_inner_ab_phase(out=None):
     overhead = _call_overhead()
     b, h, d = 1, 8, 128
     out = {} if out is None else out
-    for s in (4096, 8192, 16384):
+    sizes = (4096, 8192, 16384)
+    reps = _repeats() + 1  # _timed_op runs 1 compile-warm + repeats
+    # Seed per-iteration estimates (seconds) from the BENCH_SELF
+    # record; replaced by live measurements as sizes complete.
+    est_iter = {"xla": 2.3e-3, "flash": 0.5e-3}
+    n_left = len(sizes) * 2
+    for s in sizes:
         kq, kk, kv = jax.random.split(jax.random.key(s), 3)
         q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
         k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
         v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
         pos = jnp.broadcast_to(jnp.arange(s), (b, s))
         scale = d ** -0.5
-        iters = max(8, 65536 // (s // 1024) // 16)
 
         def xla_fn(q):
             o, m, l = _block_attn(q, k, v, pos, pos, True, scale)
@@ -363,9 +384,21 @@ def ring_inner_ab_phase(out=None):
         # (e.g. XLA OOM on the materialized logits — which IS the
         # finding) must not discard sizes already measured.
         for name, fn in (("xla", xla_fn), ("flash", flash_fn)):
+            share = max((time_left() - RESERVE_S) / max(n_left, 1), 0)
+            n_left -= 1
+            # ~20s flat allowance for the compile outside the scan.
+            iters = int((share - 20.0) / (reps * est_iter[name]))
+            iters = min(max(iters, 0), 256)
+            if iters < 4:
+                out[f"ring_inner_{name}_skipped_s{s}"] = "budget"
+                # Keep the per-iter estimate tracking the size ladder
+                # even without a measurement: the next size is ~4x.
+                est_iter[name] *= 4
+                continue
             try:
                 t = _timed_op(fn, q, iters, overhead)
                 out[f"ring_inner_{name}_ms_s{s}"] = round(t * 1e3, 2)
+                est_iter[name] = max(t, 1e-5) * 4  # next size is ~4x
             except PhaseTimeout:
                 raise  # one-shot alarm: must reach run_phase
             except Exception as e:
@@ -373,10 +406,75 @@ def ring_inner_ab_phase(out=None):
                 out[f"ring_inner_{name}_error_s{s}"] = (
                     f"{type(e).__name__}"[:60]
                 )
+                # The estimate must climb the size ladder even without
+                # a datum, or the next size's iters are ~4x oversized.
+                est_iter[name] *= 4
         tx = out.get(f"ring_inner_xla_ms_s{s}")
         tf = out.get(f"ring_inner_flash_ms_s{s}")
         if tx and tf:
             out[f"ring_inner_speedup_s{s}"] = round(tx / tf, 2)
+    return out
+
+
+def ring_overlap_phase(out=None):
+    """Collective/compute overlap A/B for ring attention (§33): the
+    SAME jitted ring step at global s=8192 over an sp mesh spanning
+    every local device, once with the overlap schedule (next chunk's
+    ppermute issued before the current chunk's flash block, final
+    wrap-around permute elided) and once with the legacy
+    compute-then-permute order (DLROVER_TPU_RING_OVERLAP=0). On a
+    single-chip run sp=1 makes the A/B degenerate (recorded as such);
+    the MULTICHIP rounds carry the real delta."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.ring_attention import make_ring_attention
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    out = {} if out is None else out
+    n_dev = len(jax.devices())
+    s, b, h, d = 8192, 1, 8, 128
+    mesh = build_mesh(MeshConfig(sp=n_dev), jax.devices())
+    out["ring_overlap_sp"] = n_dev
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+
+    def measure(overlap: bool) -> float:
+        prev = os.environ.get("DLROVER_TPU_RING_OVERLAP")
+        try:
+            os.environ["DLROVER_TPU_RING_OVERLAP"] = (
+                "1" if overlap else "0"
+            )
+            ring = make_ring_attention(mesh)
+
+            def fn(q, k, v):
+                with mesh:
+                    return ring(q, k, v, causal=True)
+
+            f = jax.jit(fn)
+            jax.block_until_ready(f(q, k, v))
+            iters, best = 20, 1e9
+            for _ in range(_repeats()):
+                t0 = time.time()
+                r = None
+                for _ in range(iters):
+                    r = f(q, k, v)
+                jax.block_until_ready(r)
+                best = min(best, time.time() - t0)
+            return best / iters
+        finally:
+            if prev is None:
+                os.environ.pop("DLROVER_TPU_RING_OVERLAP", None)
+            else:
+                os.environ["DLROVER_TPU_RING_OVERLAP"] = prev
+
+    t_on = measure(True)
+    out["ring_overlap_on_ms_s8192"] = round(t_on * 1e3, 2)
+    t_off = measure(False)
+    out["ring_overlap_off_ms_s8192"] = round(t_off * 1e3, 2)
+    out["ring_overlap_speedup_s8192"] = round(t_off / max(t_on, 1e-9), 3)
     return out
 
 
@@ -657,6 +755,12 @@ def moe_phase(out=None):
             out["moe_active_params_m"] = round(
                 cfg.count_active_params() / 1e6, 1
             )
+            from dlrover_tpu.models import moe as moe_lib
+
+            # Which dispatch the headline dropless number measured
+            # (the fused Pallas kernel unless the env A/B knob says
+            # otherwise).
+            out["moe_dispatch_impl"] = moe_lib._dispatch_impl()
         flops = 6.0 * cfg.count_active_params() * tok
         out[f"moe_{impl}_mfu_active_pct"] = round(
             100.0 * flops / device_peak_flops(), 2
@@ -718,13 +822,28 @@ def moe_crossover_sweep(out=None):
 
             return g
 
+        # Dropless twice: the fused Pallas dispatch kernel
+        # (ops/moe_dispatch, the production default) and the megablox
+        # gmm-around-XLA-gathers baseline it replaced — the fused
+        # column is what the crossover is decided against (§33).
         t = _timed_op(
             chain(lambda x, wg_: moe_lib.moe_mlp_dropless(
-                x, rw, wg_, wu, wd, top_k=2
+                x, rw, wg_, wu, wd, top_k=2, dispatch="fused"
+            )),
+            x, 10, overhead,
+        )
+        out[f"moe_sweep_fused_e{e}_ms"] = round(t * 1e3, 2)
+        t = _timed_op(
+            chain(lambda x, wg_: moe_lib.moe_mlp_dropless(
+                x, rw, wg_, wu, wd, top_k=2, dispatch="gmm"
             )),
             x, 10, overhead,
         )
         out[f"moe_sweep_dropless_e{e}_ms"] = round(t * 1e3, 2)
+        out[f"moe_fused_speedup_e{e}"] = round(
+            out[f"moe_sweep_dropless_e{e}_ms"]
+            / max(out[f"moe_sweep_fused_e{e}_ms"], 1e-6), 2
+        )
         # Two capacity points bracket the crossover (cap 1.0 adds a
         # third compile per expert count and the full sweep measured
         # 1014s on the tunnel — the budget can't carry it; the cap-1.0
@@ -738,14 +857,22 @@ def moe_crossover_sweep(out=None):
             )
             key = f"moe_sweep_gshard_e{e}_cap{int(cap * 100)}_ms"
             out[key] = round(t * 1e3, 2)
+    # Crossover re-decided against the FUSED kernel (falling back to
+    # the gmm column if a budget abort lost the fused one).
+    def dropless_ms(e_str):
+        return out.get(
+            f"moe_sweep_fused_e{e_str}_ms",
+            out.get(f"moe_sweep_dropless_e{e_str}_ms"),
+        )
+
+    def _wins(k):
+        ms = dropless_ms(k.split("_e")[1].split("_")[0])
+        return ms is not None and ms < out[k]
+
     wins = [
         k.replace("moe_sweep_gshard_", "").removesuffix("_ms")
         for k in out
-        if k.startswith("moe_sweep_gshard_")
-        and out[
-            "moe_sweep_dropless_e"
-            + k.split("_e")[1].split("_")[0] + "_ms"
-        ] < out[k]
+        if k.startswith("moe_sweep_gshard_") and _wins(k)
     ]
     out["moe_dropless_wins_at"] = wins
     out.update(moe_dropless_ep_proxy())
@@ -854,16 +981,22 @@ def decode_phase():
         ),
     }
 
-    def run_once(batch):
+    def run_once(batch, kv_dtype="fp"):
         prompt = jax.random.randint(
             jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
         ).astype(jnp.int32)
-        res = generate(cfg, params, prompt, max_new_tokens=new)
+        res = generate(
+            cfg, params, prompt, max_new_tokens=new,
+            kv_cache_dtype=kv_dtype,
+        )
         jax.block_until_ready(res.tokens)  # compile + warm
         best = 1e9
         for _ in range(3):
             t0 = _t.time()
-            res = generate(cfg, params, prompt, max_new_tokens=new)
+            res = generate(
+                cfg, params, prompt, max_new_tokens=new,
+                kv_cache_dtype=kv_dtype,
+            )
             jax.device_get(res.tokens)  # host fetch = barrier
             best = min(best, _t.time() - t0)
         return max(best - overhead, 1e-6)
@@ -871,13 +1004,19 @@ def decode_phase():
     # Roofline: every decode step reads the bf16 params once plus the
     # FILLED KV rows (averaged over the run) — that byte count over the
     # measured HBM bandwidth is the floor the kernel is judged against.
+    # int8 KV rows cost head_dim + 4 bytes per head (ops/kv_quant
+    # per-(row, head) scale) instead of 2*head_dim — the roofline
+    # itself DROPS, and the kernel is judged against the lower bar.
     param_bytes = 2 * cfg.count_params()
     avg_len = prompt_len + new / 2
 
-    def roofline_ms(batch):
+    def roofline_ms(batch, kv_dtype="fp"):
+        from dlrover_tpu.ops.kv_quant import bytes_per_head_row
+
         kv_bytes = (
             2 * cfg.n_layers * batch * avg_len
-            * cfg.n_kv_heads * cfg.head_dim * 2
+            * cfg.n_kv_heads
+            * bytes_per_head_row(cfg.head_dim, kv_dtype)
         )
         return (param_bytes + kv_bytes) / (
             out["decode_hbm_bw_gbs"] * 1e9
@@ -885,23 +1024,30 @@ def decode_phase():
 
     # Headline batch FIRST: if the budget dies mid-phase the cumulative
     # line already holds decode_ms_per_token + decode_vs_roofline.
+    # The int8-KV run at each batch point follows its fp twin so every
+    # surviving prefix of the sweep carries a comparable A/B pair.
     for batch in (8, 32, 1):
         if batch != 8 and time_left() < RESERVE_S + 60:
             break
-        dec_s = run_once(batch)
-        ms_tok = dec_s / new * 1e3
-        suffix = "" if batch == 8 else f"_b{batch}"
-        out[f"decode_batch{suffix}"] = batch
-        out[f"decode_tokens_per_s{suffix}"] = round(
-            batch * new / dec_s, 1
-        )
-        out[f"decode_ms_per_token{suffix}"] = round(ms_tok, 3)
-        out[f"decode_roofline_ms{suffix}"] = round(
-            roofline_ms(batch), 3
-        )
-        out[f"decode_vs_roofline{suffix}"] = round(
-            ms_tok / roofline_ms(batch), 2
-        )
+        for kv_dtype in ("fp", "int8"):
+            if kv_dtype == "int8" and time_left() < RESERVE_S + 45:
+                break
+            dec_s = run_once(batch, kv_dtype)
+            ms_tok = dec_s / new * 1e3
+            suffix = ("" if batch == 8 else f"_b{batch}") + (
+                "_int8" if kv_dtype == "int8" else ""
+            )
+            out[f"decode_batch{suffix}"] = batch
+            out[f"decode_tokens_per_s{suffix}"] = round(
+                batch * new / dec_s, 1
+            )
+            out[f"decode_ms_per_token{suffix}"] = round(ms_tok, 3)
+            out[f"decode_roofline_ms{suffix}"] = round(
+                roofline_ms(batch, kv_dtype), 3
+            )
+            out[f"decode_vs_roofline{suffix}"] = round(
+                ms_tok / roofline_ms(batch, kv_dtype), 2
+            )
     # A/B: the length-aware Pallas decode attention (opt-in) vs the
     # default padded-cache XLA path, at the headline batch. The pallas
     # kernel's sequential (batch, kv_head, block) grid loses here —
@@ -1578,6 +1724,14 @@ _KEEP_KEYS = {
     "serving_ttft_p50_s", "serving_ttft_p99_s", "serving_slot_util",
     "serving_kv_effective_slots", "serving_prefix_hit_rate",
     "serving_paged_vs_flat_tokens_per_s",
+    # §33 raw-speed campaign headlines: fused MoE dispatch, int8-KV
+    # decode, ring overlap — the deltas the acceptance criteria pin.
+    "moe_dropless_mfu_active_pct", "moe_dispatch_impl",
+    "moe_fused_speedup_e8", "moe_fused_speedup_e16",
+    "decode_ms_per_token_int8", "decode_vs_roofline_int8",
+    "serving_kv_effective_slots_int8", "serving_int8_token_match",
+    "serving_int8_vs_fp_tokens_per_s",
+    "ring_overlap_speedup_s8192", "ring_overlap_sp",
     "ce_auto_path",
     "soak_goodput_frac", "soak_mttr_mean_s", "soak_invariants",
     "rescale_to_first_step_s", "rescale_invariants",
@@ -1599,8 +1753,11 @@ _DROP_ORDER = (
     r"^attn_(xla|pallas|ab)",
     r"^moe_sweep_",
     r"^(goodput_mtbf|autotuned_cadence_mtbf)",
-    r"^decode_.*_b(1|32)$",
+    r"^decode_.*_b(1|32)(_int8)?$",
     r"^decode_(prompt_len|new_tokens|batch)",
+    r"^decode_(tokens_per_s|roofline_ms)_int8$",
+    r"^ring_overlap_(on|off)_ms",
+    r"^serving_(int8_(blocks|retraces)|fp_blocks)",
     r"^profiler_capture",
     r"_error$|_timeout$",
     r"^data_pipe_(records$|shard_size|batch_size|rpc_latency|step_ms"
@@ -1851,6 +2008,12 @@ def main():
         run_phase(
             result, "ring_inner_ab", ring_inner_ab_phase, est_s=140
         )
+        # Overlap-schedule A/B over the sp ring (degenerate at sp=1 on
+        # a single chip; the MULTICHIP rounds carry the real delta).
+        run_phase(
+            result, "ring_overlap", ring_overlap_phase, est_s=60,
+            cap_s=180,
+        )
     emit(result)
     # Persist the FULL (unpruned) result next to the driver artifacts:
     # the driver's 2000-char tail capture truncates, and round 4 proved
@@ -1913,6 +2076,10 @@ def prev_round_diff(now: dict) -> dict:
         "longctx_tokens_per_s",
         "ce_fused_chunked_vs_dense",
         "moe_dropless_tokens_per_s",
+        "moe_dropless_mfu_active_pct",
+        "decode_ms_per_token_int8",
+        "serving_kv_effective_slots",
+        "ring_inner_speedup_s8192",
     )
     for path in sorted(files, key=round_no, reverse=True):
         try:

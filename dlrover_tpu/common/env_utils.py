@@ -29,6 +29,29 @@ def get_env_bool(name: str, default: bool = False) -> bool:
     return env_bool(os.environ, name, default)
 
 
+_WARNED_CHOICES: set = set()
+
+
+def resolve_env_choice(name: str, allowed, default: str) -> str:
+    """Env knob constrained to ``allowed`` values, warning ONCE per
+    unrecognized value and falling back to ``default`` — a typo in a
+    kernel A/B knob must be LOUD, or the experiment silently measures
+    the wrong path. The one definition of the pattern (kv dtype, MoE
+    dispatch, decode attention all use it)."""
+    raw = os.environ.get(name, default).lower()
+    if raw in allowed:
+        return raw
+    if (name, raw) not in _WARNED_CHOICES:
+        _WARNED_CHOICES.add((name, raw))
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not one of %s; falling back to %r",
+            name, raw, tuple(allowed), default,
+        )
+    return default
+
+
 def get_env_str(name: str, default: str = "") -> str:
     return os.getenv(name, default)
 

@@ -46,15 +46,40 @@ class DecodeCache(NamedTuple):
     k: jnp.ndarray  # [layers, b, max_len, kv_heads, head_dim]
     v: jnp.ndarray
     length: jnp.ndarray  # [b] int32 — tokens filled so far, per row
+    # int8 caches only (ops/kv_quant): per-(row, head) f32 scales
+    # [layers, b, max_len, kv_heads]; None for fp caches.
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+
+def _kv_cache_dtype() -> str:
+    """"fp" (cache in compute_dtype, the default) | "int8"
+    (ops/kv_quant per-(row, head) scales — half the decode KV bytes).
+    DLROVER_TPU_KV_DTYPE picks; typos warn once and fall back to
+    "fp"."""
+    from dlrover_tpu.common.env_utils import resolve_env_choice
+
+    return resolve_env_choice(
+        "DLROVER_TPU_KV_DTYPE", ("fp", "int8"), "fp"
+    )
 
 
 def init_cache(
-    config: llama.TpuLMConfig, batch: int, max_len: int
+    config: llama.TpuLMConfig, batch: int, max_len: int,
+    kv_dtype: Optional[str] = None,
 ) -> DecodeCache:
     if config.pp_stages > 1:
         raise NotImplementedError(
             "decode runs on the flat layer stack; merge pipeline stages "
             "for inference"
+        )
+    kv_dtype = kv_dtype or _kv_cache_dtype()
+    if kv_dtype not in ("fp", "int8"):
+        # An explicit argument bypasses the env resolver's vocabulary
+        # check; silently building an fp cache would make an intended
+        # int8 A/B measure the wrong path.
+        raise ValueError(
+            f"kv_dtype {kv_dtype!r} not in ('fp', 'int8')"
         )
     shape = (
         config.n_layers,
@@ -63,6 +88,14 @@ def init_cache(
         config.n_kv_heads,
         config.head_dim,
     )
+    if kv_dtype == "int8":
+        return DecodeCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            length=jnp.zeros((batch,), jnp.int32),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
     dtype = config.compute_dtype
     return DecodeCache(
         k=jnp.zeros(shape, dtype),
@@ -91,27 +124,15 @@ def _decode_attn_impl() -> str:
     reads it saves (measured v5e b=8: 3.58 vs 1.26 ms/token against
     the append-free XLA step; the bench A/B keeps both on record). DLROVER_TPU_DECODE_ATTN=pallas opts in
     (wins would need batch*kv_heads small or caches much longer than
-    the fill)."""
-    import os
+    the fill). Typos warn once and fall back to auto → xla
+    (env_utils.resolve_env_choice: a silent "palas"→xla would make an
+    intended kernel A/B measure the wrong path)."""
+    from dlrover_tpu.common.env_utils import resolve_env_choice
 
-    raw = os.environ.get("DLROVER_TPU_DECODE_ATTN", "auto").lower()
-    if raw in ("pallas", "xla"):
-        return raw
-    if raw != "auto" and raw not in _WARNED_ATTN_VALUES:
-        # A typo here must be LOUD: silently mapping e.g. "palas" to
-        # "xla" makes an intended kernel A/B measure the wrong path.
-        _WARNED_ATTN_VALUES.add(raw)
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "DLROVER_TPU_DECODE_ATTN=%r is not one of ('pallas', "
-            "'xla', 'auto'); falling back to 'xla'",
-            raw,
-        )
-    return "xla"
-
-
-_WARNED_ATTN_VALUES: set = set()
+    raw = resolve_env_choice(
+        "DLROVER_TPU_DECODE_ATTN", ("pallas", "xla", "auto"), "auto"
+    )
+    return "xla" if raw == "auto" else raw
 
 
 def _fuse_decode_params(config, layers):
@@ -164,26 +185,50 @@ def _fused_mlp(config, p, x):
 
 def _layer_decode(
     config, p, x, positions, k_cache, v_cache, cache_len,
-    attn_impl=None,
+    attn_impl=None, k_scale=None, v_scale=None,
 ):
     """One decoder block over [b, sq] new tokens with cache append.
-    Returns (x, new_k_cache, new_v_cache). ``attn_impl`` ("pallas" |
-    "xla") is resolved by the caller; None falls back to the env knob
-    (direct callers / tests). ``cache_len`` may be scalar or a UNIFORM
-    [b] vector — the append writes at the shared cursor."""
+    Returns (x, new_k_cache, new_v_cache) — plus (new_k_scale,
+    new_v_scale) when the cache is int8 (``k_scale`` given: the append
+    quantizes per ops/kv_quant; the single-token Pallas path
+    dequantizes in-kernel, the full-cache XLA path materializes the
+    dequantized view — it only serves compute-bound prefill).
+    ``attn_impl`` ("pallas" | "xla") is resolved by the caller; None
+    falls back to the env knob (direct callers / tests). ``cache_len``
+    may be scalar or a UNIFORM [b] vector — the append writes at the
+    shared cursor."""
     residual = x
+    quantized = k_scale is not None
     if "wqkv" in p:
         q, k, v = _fused_qkv(config, p, x, positions)
     else:
         q, k, v = llama.attention_qkv(config, p, x, positions)
     # Append the new tokens' K/V at the (uniform) cache cursor.
     cursor = _uniform_cursor(cache_len)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, cursor, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, cursor, 0, 0)
-    )
+    if quantized:
+        from dlrover_tpu.ops.kv_quant import quantize_kv
+
+        kq, ks_new = quantize_kv(k)
+        vq, vs_new = quantize_kv(v)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, kq, (0, cursor, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, vq, (0, cursor, 0, 0)
+        )
+        k_scale = jax.lax.dynamic_update_slice(
+            k_scale, ks_new, (0, cursor, 0)
+        )
+        v_scale = jax.lax.dynamic_update_slice(
+            v_scale, vs_new, (0, cursor, 0)
+        )
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, cursor, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, cursor, 0, 0)
+        )
     max_len = k_cache.shape[1]
     block_k = next(
         (c for c in (128, 64, 32, 16) if max_len % c == 0), None
@@ -194,11 +239,13 @@ def _layer_decode(
         and (attn_impl or _decode_attn_impl()) == "pallas"
     ):
         # Single-token step: the length-aware kernel reads only the
-        # filled cache blocks (ops/decode_attention.py).
+        # filled cache blocks (ops/decode_attention.py); int8 caches
+        # dequantize in-kernel.
         from dlrover_tpu.ops.decode_attention import decode_attention
 
         attn = decode_attention(
-            q[:, 0], k_cache, v_cache, cache_len + 1, block_k=block_k
+            q[:, 0], k_cache, v_cache, cache_len + 1, block_k=block_k,
+            k_scale=k_scale, v_scale=v_scale,
         )[:, None]
     else:
         # Plain attention over the full pre-allocated cache; with
@@ -211,10 +258,18 @@ def _layer_decode(
         # 334M): the sequential-grid Pallas kernel (3.6 vs 1.3
         # ms/token) and lax.switch-bucketed static prefixes (no gain
         # at b>=8, b=1 0.92 -> 1.39 ms/token).
+        if quantized:
+            from dlrover_tpu.ops.kv_quant import dequantize_kv
+
+            cdt = config.compute_dtype
+            k_attn = dequantize_kv(k_cache, k_scale, cdt)
+            v_attn = dequantize_kv(v_cache, v_scale, cdt)
+        else:
+            k_attn, v_attn = k_cache, v_cache
         attn = dot_product_attention(
             q,
-            k_cache,
-            v_cache,
+            k_attn,
+            v_attn,
             causal=True,
             q_positions=positions,
             kv_positions=jnp.arange(max_len),
@@ -224,10 +279,15 @@ def _layer_decode(
         x = _fused_mlp(config, p, x)
     else:
         x, _ = llama.mlp_block(config, p, x)
+    if quantized:
+        return x, k_cache, v_cache, k_scale, v_scale
     return x, k_cache, v_cache
 
 
-def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
+def _append_free_attention(
+    q, k_cache, v_cache, k_new, v_new, cache_len,
+    k_scale=None, v_scale=None,
+):
     """Single-token attention WITHOUT materializing an updated cache.
 
     The padded-cache decode path spent 21% of device time on two
@@ -245,6 +305,13 @@ def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
     cache_len unfilled); k_new/v_new: [b, 1, kh, d]; cache_len scalar
     or PER-ROW [b] int32 — ragged fills (the serving engine's slot
     pool) mask each row at its own length. Returns [b, 1, h, d].
+
+    Int8 caches (``k_scale``/``v_scale`` [b, S, kh] — ops/kv_quant):
+    dequantization FOLDS into the math — K scales multiply the raw
+    logits, V scales the probability rows — so the dequantized cache
+    is never materialized and the step's HBM read is the int8 bytes.
+    The new token's own K/V stay full-precision here; its quantized
+    row is what LATER steps read (write-once scheme).
     """
     from dlrover_tpu.ops.attention import NEG_INF
 
@@ -258,6 +325,8 @@ def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
     logits = jnp.einsum(
         "bkgd,bskd->bkgs", q32, k_cache.astype(jnp.float32)
     )
+    if k_scale is not None:
+        logits = logits * k_scale.transpose(0, 2, 1)[:, :, None, :]
     lens = jnp.atleast_1d(jnp.asarray(cache_len, jnp.int32))
     visible = jnp.arange(skv)[None, :] < lens[:, None]  # [1|b, S]
     logits = jnp.where(visible[:, None, None, :], logits, NEG_INF)
@@ -270,27 +339,35 @@ def _append_free_attention(q, k_cache, v_cache, k_new, v_new, cache_len):
     p = jnp.where(visible[:, None, None, :], p, 0.0)
     p_new = jnp.exp(l_new - m)
     denom = jnp.sum(p, axis=-1) + p_new  # >= p_new > 0
+    pv = p if v_scale is None else (
+        p * v_scale.transpose(0, 2, 1)[:, :, None, :]
+    )
     out = (
-        jnp.einsum("bkgs,bskd->bkgd", p, v_cache.astype(jnp.float32))
+        jnp.einsum("bkgs,bskd->bkgd", pv, v_cache.astype(jnp.float32))
         + p_new[..., None] * v_new[:, 0].astype(jnp.float32)[:, :, None]
     ) / denom[..., None]
     return out.reshape(b, 1, h, d).astype(q.dtype)
 
 
 def _layer_decode_read_only(
-    config, p, x, positions, k_cache, v_cache, cache_len
+    config, p, x, positions, k_cache, v_cache, cache_len,
+    k_scale=None, v_scale=None,
 ):
     """One decoder block over [b, 1] tokens; the cache is read-only.
     Returns (x, k_new [b, 1, kh, d], v_new) — the caller batches the
     cache append across all layers (see _append_free_attention).
     ``cache_len`` may be a ragged [b] vector: positions and masking are
-    per-row, which is what the serving engine's decode step drives."""
+    per-row, which is what the serving engine's decode step drives.
+    ``k_scale``/``v_scale`` mark an int8 cache (folded dequant)."""
     residual = x
     if "wqkv" in p:
         q, k, v = _fused_qkv(config, p, x, positions)
     else:
         q, k, v = llama.attention_qkv(config, p, x, positions)
-    attn = _append_free_attention(q, k_cache, v_cache, k, v, cache_len)
+    attn = _append_free_attention(
+        q, k_cache, v_cache, k, v, cache_len,
+        k_scale=k_scale, v_scale=v_scale,
+    )
     x = llama.attention_out(config, p, attn, residual)
     if "w_gu" in p:
         x = _fused_mlp(config, p, x)
@@ -334,6 +411,8 @@ def _forward_with_cache(
     ]
     x = llama.embed_tokens(config, params, tokens)
     unroll = unroll or _layer_scan_unroll(config.n_layers)
+    quantized = cache.k_scale is not None
+    new_ks = new_vs = None
 
     if sq == 1 and (attn_impl or _decode_attn_impl()) != "pallas":
         # Append-free single-token step (the decode hot loop): the
@@ -341,26 +420,78 @@ def _forward_with_cache(
         # token's K/V, and one small dynamic-update-slice appends all
         # layers at once. The padded-cache path below rebuilt the full
         # cache as stacked scan outputs — 100-200MB of per-token copy
-        # traffic, 21% of decode device time (v5e op profile).
-        def body1(carry, layer_in):
-            pl, k_c, v_c = layer_in
-            y, k_new, v_new = _layer_decode_read_only(
-                config, pl, carry, positions, k_c, v_c, cache.length
-            )
-            return y, (k_new, v_new)
+        # traffic, 21% of decode device time (v5e op profile). Int8
+        # caches stream half those bytes (dequant folded into the
+        # attention math); the append quantizes each layer's new row.
+        if quantized:
+            def body1(carry, layer_in):
+                pl, k_c, v_c, ks, vs = layer_in
+                y, k_new, v_new = _layer_decode_read_only(
+                    config, pl, carry, positions, k_c, v_c,
+                    cache.length, k_scale=ks, v_scale=vs,
+                )
+                return y, (k_new, v_new)
 
-        x, (k_news, v_news) = jax.lax.scan(
-            body1, x, (params["layers"], cache.k, cache.v),
-            unroll=unroll,
-        )
+            x, (k_news, v_news) = jax.lax.scan(
+                body1, x,
+                (params["layers"], cache.k, cache.v,
+                 cache.k_scale, cache.v_scale),
+                unroll=unroll,
+            )
+        else:
+            def body1(carry, layer_in):
+                pl, k_c, v_c = layer_in
+                y, k_new, v_new = _layer_decode_read_only(
+                    config, pl, carry, positions, k_c, v_c,
+                    cache.length,
+                )
+                return y, (k_new, v_new)
+
+            x, (k_news, v_news) = jax.lax.scan(
+                body1, x, (params["layers"], cache.k, cache.v),
+                unroll=unroll,
+            )
         cursor = _uniform_cursor(cache.length)
-        new_k = jax.lax.dynamic_update_slice(
-            cache.k, k_news.astype(cache.k.dtype),
-            (0, 0, cursor, 0, 0),
-        )
-        new_v = jax.lax.dynamic_update_slice(
-            cache.v, v_news.astype(cache.v.dtype),
-            (0, 0, cursor, 0, 0),
+        if quantized:
+            from dlrover_tpu.ops.kv_quant import quantize_kv
+
+            kq, ks_rows = quantize_kv(k_news)
+            vq, vs_rows = quantize_kv(v_news)
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, kq, (0, 0, cursor, 0, 0)
+            )
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, vq, (0, 0, cursor, 0, 0)
+            )
+            new_ks = jax.lax.dynamic_update_slice(
+                cache.k_scale, ks_rows, (0, 0, cursor, 0)
+            )
+            new_vs = jax.lax.dynamic_update_slice(
+                cache.v_scale, vs_rows, (0, 0, cursor, 0)
+            )
+        else:
+            new_k = jax.lax.dynamic_update_slice(
+                cache.k, k_news.astype(cache.k.dtype),
+                (0, 0, cursor, 0, 0),
+            )
+            new_v = jax.lax.dynamic_update_slice(
+                cache.v, v_news.astype(cache.v.dtype),
+                (0, 0, cursor, 0, 0),
+            )
+    elif quantized:
+        def body_q(carry, layer_in):
+            pl, k_c, v_c, ks, vs = layer_in
+            y, k_c, v_c, ks, vs = _layer_decode(
+                config, pl, carry, positions, k_c, v_c, cache.length,
+                attn_impl=attn_impl, k_scale=ks, v_scale=vs,
+            )
+            return y, (k_c, v_c, ks, vs)
+
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            body_q, x,
+            (params["layers"], cache.k, cache.v,
+             cache.k_scale, cache.v_scale),
+            unroll=unroll,
         )
     else:
         def body(carry, layer_in):
@@ -376,25 +507,38 @@ def _forward_with_cache(
             unroll=unroll,
         )
     logits = llama.unembed(config, params, x[:, -1:, :])[:, 0, :]
-    new_cache = DecodeCache(k=new_k, v=new_v, length=cache.length + sq)
+    new_cache = DecodeCache(
+        k=new_k, v=new_v, length=cache.length + sq,
+        k_scale=new_ks, v_scale=new_vs,
+    )
     return logits, new_cache
 
 
 def sample_token(logits, rng, temperature):
     """Greedy-or-sampled next token over the last axis of ``logits``
     ([V], [b, V], ...). ``temperature`` is a TRACED scalar or per-row
-    vector; <= 0 means argmax. Both branches trace (the categorical's
-    gumbel pass is noise next to never retracing on a temperature
-    change). ONE definition shared by generate()'s pick and the
-    serving engine's decode/prefill samplers — the sampling rule must
-    never drift between batch generation and serving."""
+    vector; <= 0 means argmax. ONE definition shared by generate()'s
+    pick and the serving engine's decode/prefill samplers — the
+    sampling rule must never drift between batch generation and
+    serving.
+
+    Fused gumbel-max form: categorical sampling IS
+    ``argmax(logits/t + gumbel)`` — drawing the SAME gumbel noise
+    ``jax.random.categorical`` would (same key, same shape) and
+    zeroing it where t <= 0 (a positive 1/t rescale never moves an
+    argmax) collapses the old categorical + argmax + select — three
+    full passes over the [b, V] logits — into ONE perturbed argmax
+    pass. Token-identical to the previous implementation for every
+    (key, temperature)."""
     t = jnp.asarray(temperature, jnp.float32)
     t_rows = t[..., None] if t.ndim else t
-    sampled = jax.random.categorical(
-        rng, logits / jnp.maximum(t_rows, 1e-6), axis=-1
-    ).astype(jnp.int32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jnp.where(t > 0.0, sampled, greedy)
+    z = logits / jnp.maximum(t_rows, 1e-6)
+    gumbel = jax.random.gumbel(rng, z.shape, z.dtype)
+    # t <= 0 rows select the RAW logits (not the 1/t-rescaled copy):
+    # rescaling is argmax-preserving in exact arithmetic but could
+    # round two near-ties together in low precision.
+    z = jnp.where(t_rows > 0.0, z + gumbel, logits)
+    return jnp.argmax(z, axis=-1).astype(jnp.int32)
 
 
 def prepare_decode_params(config, params):
@@ -437,6 +581,7 @@ def _compiled_generate(
     max_len: int,
     attn_impl: str = "xla",
     unroll: int = 0,
+    kv_dtype: str = "fp",
 ):
     """One compiled program per (config, shapes, attn_impl, unroll) —
     repeat generate() calls reuse it (jit caches key on the function
@@ -452,7 +597,7 @@ def _compiled_generate(
 
     def run(params, prompt, rng, temperature):
         params = prepare_decode_params(config, params)
-        cache = init_cache(config, batch, max_len)
+        cache = init_cache(config, batch, max_len, kv_dtype=kv_dtype)
         logits, cache = _forward_with_cache(
             config, params, prompt, cache, attn_impl=attn_impl,
             unroll=unroll or None,
@@ -489,9 +634,13 @@ def generate(
     max_len: Optional[int] = None,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    kv_cache_dtype: Optional[str] = None,
 ) -> GenerateResult:
     """Greedy (temperature=0) or sampled decoding. The prefill and the
-    whole decode loop are one jit-compiled program with static shapes."""
+    whole decode loop are one jit-compiled program with static shapes.
+    ``kv_cache_dtype``: "fp" (default) | "int8" — int8 halves the KV
+    bytes every decode step streams (DLROVER_TPU_KV_DTYPE sets the
+    default; the dtype is a compile-cache key, not a retrace)."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     b, prompt_len = prompt.shape
@@ -507,6 +656,7 @@ def generate(
         config, b, max_new_tokens, max_len,
         attn_impl=_decode_attn_impl(),
         unroll=_layer_scan_unroll(config.n_layers),
+        kv_dtype=kv_cache_dtype or _kv_cache_dtype(),
     )
     # np.float32, not a Python float: a weakly-typed scalar would give
     # the traced argument a different avals key and retrace once.

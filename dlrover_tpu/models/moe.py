@@ -225,14 +225,32 @@ def _dispatch_bwd(top_k, res, g):
 _gather_dispatch.defvjp(_dispatch_fwd, _dispatch_bwd)
 
 
-def _dropless_core(xf, router_w, w_gate, w_up, w_down, top_k, interpret):
+def _dispatch_impl() -> str:
+    """"fused" (ops/moe_dispatch grouped kernel, the default) | "gmm"
+    (megablox grouped matmuls around XLA gathers — the A/B baseline)
+    for the dropless expert compute. DLROVER_TPU_MOE_DISPATCH picks;
+    typos warn once and fall back to "fused"."""
+    from dlrover_tpu.common.env_utils import resolve_env_choice
+
+    return resolve_env_choice(
+        "DLROVER_TPU_MOE_DISPATCH", ("fused", "gmm"), "fused"
+    )
+
+
+def _dropless_core(
+    xf, router_w, w_gate, w_up, w_down, top_k, interpret, dispatch=None
+):
     """Sorted grouped-matmul expert compute over flat tokens [n, d] ->
-    out [n, d] f32. Local to one device (all experts resident)."""
+    out [n, d] f32. Local to one device (all experts resident).
+    ``dispatch``: "fused" routes through the ops/moe_dispatch Pallas
+    kernel (gather→GEMM→scatter in one pass, custom VJP on the same
+    permutation); "gmm" keeps the megablox path with XLA gathers."""
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
     n, d = xf.shape
     e = router_w.shape[-1]
     m = n * top_k
+    dispatch = dispatch or _dispatch_impl()
 
     router_logits = jnp.einsum(
         "nd,de->ne", xf.astype(jnp.float32),
@@ -245,6 +263,28 @@ def _dropless_core(xf, router_w, w_gate, w_up, w_down, top_k, interpret):
     )
 
     flat_expert = experts.reshape(m)
+
+    if dispatch == "fused":
+        from dlrover_tpu.ops import moe_dispatch as md
+
+        cdt = xf.dtype
+        tm = md.default_tile_m(m)
+        row_ids, dest_ids, tile_expert = md.build_dispatch_layout(
+            flat_expert, e, tm, top_k
+        )
+        w_gu = jnp.concatenate(
+            [w_gate.astype(cdt), w_up.astype(cdt)], axis=-1
+        )
+        out_tok = md.grouped_ffn(
+            xf, w_gu, w_down.astype(cdt), row_ids, dest_ids,
+            tile_expert, m, top_k, tm, interpret,
+        )
+        return jnp.sum(
+            out_tok.reshape(n, top_k, d).astype(jnp.float32)
+            * gates[:, :, None],
+            axis=1,
+        )
+
     order = jnp.argsort(flat_expert, stable=True)       # [m]
     inv_order = jnp.argsort(order)
     xs = _gather_dispatch(xf, order, inv_order, top_k)  # [m, d] sorted
@@ -307,6 +347,7 @@ def moe_mlp_dropless(
     w_down,       # [experts, mlp, embed]
     top_k: int = 2,
     interpret=None,
+    dispatch=None,
 ):
     """x: [batch, seq, embed] -> (out, MoEMetrics). Zero dropped tokens.
 
@@ -321,7 +362,7 @@ def moe_mlp_dropless(
     b, s, d = x.shape
     out = _dropless_core(
         x.reshape(b * s, d), router_w, w_gate, w_up, w_down,
-        top_k, interpret,
+        top_k, interpret, dispatch=dispatch,
     )
     out = with_logical_constraint(
         out.astype(x.dtype).reshape(b, s, d), ("batch", "seq", "embed")
@@ -338,6 +379,7 @@ def moe_mlp_dropless_sharded(
     mesh,
     top_k: int = 2,
     interpret=None,
+    dispatch=None,
 ):
     """Dropless MoE on a multi-device mesh WITHOUT expert parallelism:
     every device holds all experts, so each shard routes and computes
@@ -357,7 +399,8 @@ def moe_mlp_dropless_sharded(
     def body(xl, rw, wg, wu, wd):
         bl, sl, _ = xl.shape
         out = _dropless_core(
-            xl.reshape(bl * sl, d), rw, wg, wu, wd, top_k, interpret
+            xl.reshape(bl * sl, d), rw, wg, wu, wd, top_k, interpret,
+            dispatch=dispatch,
         )
         return out.astype(xl.dtype).reshape(bl, sl, d)
 
@@ -439,6 +482,7 @@ def moe_mlp_dropless_ep(
     top_k: int = 2,
     axis_name: str = "ep",
     interpret=None,
+    dispatch=None,
 ):
     """Dropless MoE that SURVIVES expert parallelism (the ep==1-only
     restriction of :func:`moe_mlp_dropless` lifted).
@@ -546,35 +590,54 @@ def moe_mlp_dropless_ep(
         )
         n_recv = my_counts.sum()
         # Padding rows past n_recv got arbitrary repeat values; force
-        # them to the sentinel group so they sort to the end.
+        # them to the sentinel group (>= e_loc) so the fused layout
+        # drops them / the gmm sort sends them to the end.
         row_expert = jnp.where(
             jnp.arange(cap_rows) < n_recv, row_expert, e_loc
         )
-        order2 = jnp.argsort(row_expert, stable=True)
-        inv2 = jnp.argsort(order2)
-        xs2 = _permute_rows(recv, order2, inv2)
-        group_sizes = jnp.bincount(
-            row_expert, length=e_loc + 1
-        ).astype(jnp.int32)
-        # gmm groups must cover all rows: fold the pad tail (zero rows,
-        # zero outputs regardless of expert) into the last real group.
-        group_sizes = (
-            group_sizes[:e_loc].at[e_loc - 1].add(group_sizes[e_loc])
-        )
-
         w_gu = jnp.concatenate([wg.astype(cdt), wu.astype(cdt)], -1)
-        hu = gmm(
-            xs2, w_gu, group_sizes, interpret=interpret,
-            tiling=(_tile(cap_rows), _tile(d), _tile(2 * f)),
-        )
-        a = (jax.nn.silu(hu[:, :f]) * hu[:, f:]).astype(cdt)
-        ys2 = gmm(
-            a, wd.astype(cdt), group_sizes, interpret=interpret,
-            tiling=(_tile(cap_rows), _tile(f), _tile(d)),
-        ).astype(cdt)
 
-        # Unsort to (src, expert)-major and ship results home.
-        ys = _permute_rows(ys2, inv2, order2)
+        if (dispatch or _dispatch_impl()) == "fused":
+            # The SAME grouped kernel as the local core, driven by the
+            # exchange layout: row_ids gather the (src, expert)-major
+            # received rows per expert segment and dest_ids scatter
+            # results straight back to that layout — the xs2/ys2
+            # [cap_rows, d] permute round-trips disappear.
+            from dlrover_tpu.ops import moe_dispatch as md
+
+            tm = md.default_tile_m(cap_rows)
+            row_ids, dest_ids, tile_expert = md.build_dispatch_layout(
+                row_expert, e_loc, tm, 1
+            )
+            ys = md.grouped_ffn(
+                recv, w_gu, wd.astype(cdt), row_ids, dest_ids,
+                tile_expert, cap_rows, 1, tm, interpret,
+            ).astype(cdt)
+        else:
+            order2 = jnp.argsort(row_expert, stable=True)
+            inv2 = jnp.argsort(order2)
+            xs2 = _permute_rows(recv, order2, inv2)
+            group_sizes = jnp.bincount(
+                row_expert, length=e_loc + 1
+            ).astype(jnp.int32)
+            # gmm groups must cover all rows: fold the pad tail (zero
+            # rows, zero outputs regardless of expert) into the last
+            # real group.
+            group_sizes = (
+                group_sizes[:e_loc]
+                .at[e_loc - 1].add(group_sizes[e_loc])
+            )
+            hu = gmm(
+                xs2, w_gu, group_sizes, interpret=interpret,
+                tiling=(_tile(cap_rows), _tile(d), _tile(2 * f)),
+            )
+            a = (jax.nn.silu(hu[:, :f]) * hu[:, f:]).astype(cdt)
+            ys2 = gmm(
+                a, wd.astype(cdt), group_sizes, interpret=interpret,
+                tiling=(_tile(cap_rows), _tile(f), _tile(d)),
+            ).astype(cdt)
+            # Unsort to (src, expert)-major and ship results home.
+            ys = _permute_rows(ys2, inv2, order2)
         back = _exchange(ys, sizes_mat, me, ep, axis_name, reverse=True)
 
         # Home layout equals the original sorted xs rows; unsort and

@@ -41,11 +41,21 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _kernel(
-    len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, block_k: int, scale: float,
+def _decode_body(
+    len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+    o_ref, m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
 ):
+    """Online-softmax sweep shared by the fp and int8 kernels. With
+    scale refs present the KV blocks are int8 and dequantization is
+    folded into the math IN-KERNEL: the per-(row, head) K scales
+    multiply the raw q·k logits and the V scales fold into the
+    probability rows before the p·v matmul — the dequantized cache is
+    never materialized, and HBM moves half the bytes. Scale blocks
+    carry ALL kv heads ([1, bk, kh] — a full minor dim, which Mosaic
+    pads, unlike a 1-wide lane slice it could reject) and the kernel
+    selects its own head's column by the grid index."""
     ib = pl.program_id(0)
+    ih = pl.program_id(1)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -63,10 +73,20 @@ def _kernel(
         q = q_ref[0, 0]                                 # [gp, d]
         k = k_ref[0]                                    # [bk, d]
         v = v_ref[0]
+        if ks_ref is not None:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            q = q.astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                       # [gp, bk]
+        if ks_ref is not None:
+            # Dequantized logits: s_true = (q · k_q) * scale * k_scale
+            ks = jax.lax.dynamic_slice_in_dim(
+                ks_ref[0], ih, 1, axis=1
+            )[:, 0]
+            s = s * ks[None, :]
         cols = base + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
@@ -80,8 +100,17 @@ def _kernel(
             l_ref[:, :1] * corr + jnp.sum(p, -1, keepdims=True),
             l_ref.shape,
         )
+        # V dequant folds into the probability rows (l above keeps the
+        # UNSCALED p — it is the softmax denominator).
+        if vs_ref is None:
+            pv = p
+        else:
+            vs = jax.lax.dynamic_slice_in_dim(
+                vs_ref[0], ih, 1, axis=1
+            )[:, 0]
+            pv = p * vs[None, :]
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -93,6 +122,26 @@ def _kernel(
         ).astype(o_ref.dtype)
 
 
+def _kernel(
+    len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_k: int, scale: float,
+):
+    _decode_body(
+        len_ref, q_ref, k_ref, v_ref, None, None,
+        o_ref, m_ref, l_ref, acc_ref, block_k=block_k, scale=scale,
+    )
+
+
+def _kernel_q8(
+    len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+    o_ref, m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
+):
+    _decode_body(
+        len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+        o_ref, m_ref, l_ref, acc_ref, block_k=block_k, scale=scale,
+    )
+
+
 def _paged_kernel(
     len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, block_k: int, scale: float,
@@ -100,9 +149,20 @@ def _paged_kernel(
     # The block table is consumed entirely by the kv index maps; the
     # compute body is the flat kernel's online-softmax sweep unchanged.
     del bt_ref
-    _kernel(
-        len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-        block_k=block_k, scale=scale,
+    _decode_body(
+        len_ref, q_ref, k_ref, v_ref, None, None,
+        o_ref, m_ref, l_ref, acc_ref, block_k=block_k, scale=scale,
+    )
+
+
+def _paged_kernel_q8(
+    len_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+    o_ref, m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
+):
+    del bt_ref
+    _decode_body(
+        len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+        o_ref, m_ref, l_ref, acc_ref, block_k=block_k, scale=scale,
     )
 
 
@@ -113,6 +173,8 @@ def paged_decode_attention(
     block_tables,  # [b, max_blocks] int32 — pool rows per sequence
     length,        # [b] int32 — filled LOGICAL rows per sequence
     interpret=None,
+    k_scale=None,  # [num_blocks, block_size, kv_heads] f32 — int8 pools
+    v_scale=None,
 ):
     """Single-query attention straight through a block table.
 
@@ -127,7 +189,10 @@ def paged_decode_attention(
     copy, the same Mosaic trick as the flat kernel). Visibility is the
     engine invariant — a logical row is read iff ``< length[ib]`` —
     so stale ids beyond the fill in a table row are never dereferenced
-    into the softmax. Returns ``[b, n_heads, d]``."""
+    into the softmax. With ``k_scale``/``v_scale`` the pools are int8
+    (ops/kv_quant per-(row, head) scheme) and dequantization happens
+    in-kernel — half the KV bytes per step. Returns
+    ``[b, n_heads, d]``."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, d = q.shape
@@ -155,21 +220,40 @@ def paged_decode_attention(
     kf = k_pool.reshape(nb_pool, block_size, kh * d)
     vf = v_pool.reshape(nb_pool, block_size, kh * d)
 
-    out = pl.pallas_call(
-        functools.partial(
-            _paged_kernel, block_k=block_size, scale=scale
+    quantized = k_scale is not None
+    kernel = _paged_kernel_q8 if quantized else _paged_kernel
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, gp, d),
+            lambda ib, ih, j, ln, bt: (ib, ih, 0, 0),
         ),
+        pl.BlockSpec((1, block_size, d), kv_index),
+        pl.BlockSpec((1, block_size, d), kv_index),
+    ]
+    operands = [length, tables, qg, kf, vf]
+    if quantized:
+        # Per-(row, head) scale blocks ride the SAME table-deref row
+        # clamp as their KV blocks but carry ALL kh heads (full minor
+        # dim — Mosaic pads it; the kernel picks its head's column).
+        def scale_index(ib, ih, j, len_ref, bt_ref):
+            last = jnp.maximum((len_ref[ib] - 1) // block_size, 0)
+            return (bt_ref[ib, jnp.minimum(j, last)], 0, 0)
+
+        in_specs += [
+            pl.BlockSpec((1, block_size, kh), scale_index),
+            pl.BlockSpec((1, block_size, kh), scale_index),
+        ]
+        operands += [
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32),
+        ]
+
+    out = pl.pallas_call(
+        functools.partial(kernel, block_k=block_size, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, kh, max_blocks),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, gp, d),
-                    lambda ib, ih, j, ln, bt: (ib, ih, 0, 0),
-                ),
-                pl.BlockSpec((1, block_size, d), kv_index),
-                pl.BlockSpec((1, block_size, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, gp, d),
                 lambda ib, ih, j, ln, bt: (ib, ih, 0, 0),
@@ -182,7 +266,7 @@ def paged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), q.dtype),
         interpret=interpret,
-    )(length, tables, qg, kf, vf)
+    )(*operands)
     return out[:, :, :g, :].reshape(b, h, d)
 
 
@@ -193,6 +277,8 @@ def decode_attention(
     length,       # [] or [b] int32 — filled cache rows per sequence
     block_k: int = 128,
     interpret=None,
+    k_scale=None,  # [b, max_len, kv_heads] f32 — int8 caches only
+    v_scale=None,
 ):
     """Length-masked single-query attention; returns [b, n_heads, d].
 
@@ -200,7 +286,10 @@ def decode_attention(
     same block range, the original generate() contract) or a [b] vector
     of per-row fills (ragged slots — the serving engine's case, where
     each (batch, kv-head) grid cell reads only its own row's filled
-    blocks). Rows with length 0 produce zero output."""
+    blocks). Rows with length 0 produce zero output. With
+    ``k_scale``/``v_scale`` the caches are int8 (ops/kv_quant) and the
+    kernel dequantizes in-kernel — the HBM stream the decode roofline
+    is judged against halves."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, d = q.shape
@@ -236,18 +325,38 @@ def decode_attention(
     kf = k_cache.reshape(b, max_len, kh * d)
     vf = v_cache.reshape(b, max_len, kh * d)
 
+    quantized = k_scale is not None
+    kernel = _kernel_q8 if quantized else _kernel
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, gp, d), lambda ib, ih, j, s: (ib, ih, 0, 0)
+        ),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    operands = [length, qg, kf, vf]
+    if quantized:
+        # Full-kh scale blocks (see paged variant for the Mosaic
+        # minor-dim rationale); same per-row fill clamp as K/V.
+        def scale_index(ib, ih, j, len_ref):
+            last = jnp.maximum((len_ref[ib] - 1) // block_k, 0)
+            return (ib, jnp.minimum(j, last), 0)
+
+        in_specs += [
+            pl.BlockSpec((1, block_k, kh), scale_index),
+            pl.BlockSpec((1, block_k, kh), scale_index),
+        ]
+        operands += [
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32),
+        ]
+
     out = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, scale=scale),
+        functools.partial(kernel, block_k=block_k, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, kh, nj),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, gp, d), lambda ib, ih, j, s: (ib, ih, 0, 0)
-                ),
-                pl.BlockSpec((1, block_k, d), kv_index),
-                pl.BlockSpec((1, block_k, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, gp, d), lambda ib, ih, j, s: (ib, ih, 0, 0)
             ),
@@ -259,5 +368,5 @@ def decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, gp, d), q.dtype),
         interpret=interpret,
-    )(length, qg, kf, vf)
+    )(*operands)
     return out[:, :, :g, :].reshape(b, h, d)

@@ -1,0 +1,60 @@
+"""Int8 KV-cache quantization: per-(row, head) scales, symmetric.
+
+Decode is HBM-bandwidth-bound and the KV cache is the stream that
+grows with context: BENCH_SELF pins the decode step at 1.33–1.46× the
+HBM roofline with bf16 KV. Storing the cache as int8 halves the bytes
+every decode step must move — the direct lever on that gap — and
+doubles how many paged-KV blocks fit in the same HBM (the serving
+capacity axis of docs/DESIGN.md §31).
+
+Scheme: one f32 scale per KV **head per cache row** (``amax / 127``
+over the head_dim vector — the finest granularity that adds no
+per-element metadata). A head's K row is written once and never
+updated, so the scale is computed at append time and immutable after;
+d=128 int8 values + one f32 scale = 132 bytes/head/row vs 256 for
+bf16 (1.94×). Dequantization happens at the READ site — folded into
+the attention math (scales applied to logits / probabilities, never
+materializing a dequantized cache) in the XLA append-free step, and
+in-kernel in the Pallas decode kernels (ops/decode_attention.py).
+
+The quantizer is round-to-nearest (deterministic — the cache must be
+bit-stable across replays); clipping is impossible by construction
+(values are scaled by their own amax).
+"""
+
+import jax.numpy as jnp
+
+# Scales of all-zero rows would be 0 -> 0/0 at dequant; clamp to a
+# denormal-free floor instead (the quantized values are 0 either way).
+_SCALE_FLOOR = 1e-20
+
+
+def quantize_kv(x):
+    """x [..., d] float -> (q int8 [..., d], scale f32 [...]).
+
+    ``q * scale[..., None]`` reconstructs x to within amax/254 per
+    element (symmetric round-to-nearest over the head_dim vector)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, _SCALE_FLOOR)
+    q = jnp.round(xf / scale[..., None])
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Materializing inverse (tests / prefill views); the hot decode
+    paths fold ``scale`` into logits/probabilities instead."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def bytes_per_head_row(
+    head_dim: int, kv_dtype: str, fp_itemsize: int = 2
+) -> int:
+    """HBM bytes one KV head's cache row costs under this scheme —
+    int8 values plus the one f32 scale, or ``head_dim * fp_itemsize``
+    for fp caches. The ONE definition shared by the paged engine's
+    block gauge, the equal-HBM serving bench sizing, and the decode
+    roofline, so the three byte accounts can never drift."""
+    if kv_dtype == "int8":
+        return head_dim + 4
+    return head_dim * fp_itemsize

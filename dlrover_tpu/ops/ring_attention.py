@@ -68,6 +68,19 @@ def _block_attn(q, k, v, q_pos, kv_pos, causal, scale):
     return o, m, l
 
 
+def _ring_overlap() -> bool:
+    """Collective/compute overlap schedule (default on): each hop
+    ISSUES the next chunk's ppermute before running the current
+    chunk's attention block, so the collective-permute-start flows
+    into the scheduler ahead of the matmuls it must hide behind, and
+    the final hop elides the wasted wrap-around K/V permute entirely
+    (n-1 rotations instead of n). DLROVER_TPU_RING_OVERLAP=0 restores
+    the legacy compute-then-permute order for the bench A/B."""
+    from dlrover_tpu.common.env_utils import get_env_bool
+
+    return get_env_bool("DLROVER_TPU_RING_OVERLAP", True)
+
+
 def ring_attention_local(
     q,
     k,
@@ -85,7 +98,7 @@ def ring_attention_local(
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     groups = h // hkv
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
 
     o0 = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
@@ -93,8 +106,7 @@ def ring_attention_local(
     l0 = jnp.zeros((b, hkv, groups, sq), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(i, carry):
-        o, m, l, k_cur, v_cur, kv_pos = carry
+    def block_merge(o, m, l, k_cur, v_cur, kv_pos):
         bo, bm, bl = _block_attn(
             q, k_cur, v_cur, q_positions, kv_pos, causal, scale
         )
@@ -103,17 +115,38 @@ def ring_attention_local(
         bcorr = jnp.exp(bm - m_new)
         o = o * corr[..., None] + bo * bcorr[..., None]
         l = l * corr + bl * bcorr
-        m = m_new
-        # Rotate K/V one hop around the ring (overlaps with next block's
-        # compute under XLA latency hiding).
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
-        return (o, m, l, k_cur, v_cur, kv_pos)
+        return o, m_new, l
 
-    o, m, l, _, _, _ = jax.lax.fori_loop(
-        0, n, step, (o0, m0, l0, k, v, kv_positions)
-    )
+    if _ring_overlap():
+        def step(i, carry):
+            o, m, l, k_cur, v_cur, kv_pos = carry
+            # Next chunk's rotation is issued BEFORE this chunk's
+            # attention block: the permute depends only on the carry,
+            # so its transfer hides behind the block's matmuls.
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            p_nxt = jax.lax.ppermute(kv_pos, axis_name, perm)
+            o, m, l = block_merge(o, m, l, k_cur, v_cur, kv_pos)
+            return (o, m, l, k_nxt, v_nxt, p_nxt)
+
+        o, m, l, k_l, v_l, p_l = jax.lax.fori_loop(
+            0, n - 1, step, (o0, m0, l0, k, v, kv_positions)
+        )
+        # Final chunk: compute only — the wrap-around permute that the
+        # legacy schedule paid (result discarded) is gone.
+        o, m, l = block_merge(o, m, l, k_l, v_l, p_l)
+    else:
+        def step(i, carry):
+            o, m, l, k_cur, v_cur, kv_pos = carry
+            o, m, l = block_merge(o, m, l, k_cur, v_cur, kv_pos)
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
+            return (o, m, l, k_cur, v_cur, kv_pos)
+
+        o, m, l, _, _, _ = jax.lax.fori_loop(
+            0, n, step, (o0, m0, l0, k, v, kv_positions)
+        )
     out = o / jnp.maximum(l, 1e-30)[..., None]
     out = jnp.where((m > NEG_INF / 2)[..., None], out, 0.0)
     # [b, hkv, g, sq, d] -> [b, sq, h, d]
@@ -202,7 +235,7 @@ def _contiguity_poison(q_pos, kv_pos):
 
 def _ring_flash_fwd(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
     b, sq, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     scale = scale if scale is not None else d ** -0.5
     q_off = q_pos[0, 0]
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -213,8 +246,7 @@ def _ring_flash_fwd(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
             jnp.full((b, h, sq), NEG_INF, jnp.float32),
         )
 
-    def hop(i, carry):
-        o, lse, k_cur, v_cur, kvp = carry
+    def block_merge(o, lse, k_cur, v_cur, kvp):
         kv_off = kvp[0, 0]
         if causal:
             out_b, lse_b = jax.lax.cond(
@@ -228,17 +260,37 @@ def _ring_flash_fwd(q, k, v, q_pos, kv_pos, axis_name, causal, scale):
             )
         else:
             out_b, lse_b = _flash_block(q, k_cur, v_cur, False, scale)
-        o, lse = _merge(o, lse, out_b, lse_b)
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        kvp = jax.lax.ppermute(kvp, axis_name, perm)
-        return (o, lse, k_cur, v_cur, kvp)
+        return _merge(o, lse, out_b, lse_b)
 
     o0 = jnp.zeros((b, sq, h, d), jnp.float32)
     lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    o, lse, _, _, _ = jax.lax.fori_loop(
-        0, n, hop, (o0, lse0, k, v, kv_pos)
-    )
+    if _ring_overlap():
+        def hop(i, carry):
+            o, lse, k_cur, v_cur, kvp = carry
+            # Rotation first: the ppermute-start is in flight while the
+            # flash kernel chews the chunk it already holds (§33).
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            kvp_nxt = jax.lax.ppermute(kvp, axis_name, perm)
+            o, lse = block_merge(o, lse, k_cur, v_cur, kvp)
+            return (o, lse, k_nxt, v_nxt, kvp_nxt)
+
+        o, lse, k_l, v_l, kvp_l = jax.lax.fori_loop(
+            0, n - 1, hop, (o0, lse0, k, v, kv_pos)
+        )
+        o, lse = block_merge(o, lse, k_l, v_l, kvp_l)
+    else:
+        def hop(i, carry):
+            o, lse, k_cur, v_cur, kvp = carry
+            o, lse = block_merge(o, lse, k_cur, v_cur, kvp)
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            kvp = jax.lax.ppermute(kvp, axis_name, perm)
+            return (o, lse, k_cur, v_cur, kvp)
+
+        o, lse, _, _, _ = jax.lax.fori_loop(
+            0, n, hop, (o0, lse0, k, v, kv_pos)
+        )
     if causal:
         # Only causal masking consults positions; bidirectional ring
         # attention is position-free and needs no guard.
@@ -262,7 +314,7 @@ def _ring_bwd_rule(axis_name, causal, scale, res, g):
 
     q, k, v, q_pos, kv_pos, out, lse = res
     b, sq, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     scale_v = scale if scale is not None else d ** -0.5
     interpret = jax.default_backend() != "tpu"
     q_off = q_pos[0, 0]
@@ -288,8 +340,7 @@ def _ring_bwd_rule(axis_name, causal, scale, res, g):
             jnp.zeros_like(vT_cur),
         )
 
-    def hop(i, carry):
-        dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp = carry
+    def block_grads(dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp):
         kv_off = kvp[0, 0]
 
         def run(causal_blk):
@@ -308,24 +359,60 @@ def _ring_bwd_rule(axis_name, causal, scale, res, g):
             )
         else:
             dqb, dkb, dvb = run(False)()
-        dqT = dqT + dqb.astype(jnp.float32)
-        dkT_acc = dkT_acc + dkb.astype(jnp.float32)
-        dvT_acc = dvT_acc + dvb.astype(jnp.float32)
-        # dk/dv accumulators ride the ring WITH k/v: after n hops each
-        # shard's accumulated gradient is back on the shard that owns it.
-        kT_cur = jax.lax.ppermute(kT_cur, axis_name, perm)
-        vT_cur = jax.lax.ppermute(vT_cur, axis_name, perm)
-        kvp = jax.lax.ppermute(kvp, axis_name, perm)
-        dkT_acc = jax.lax.ppermute(dkT_acc, axis_name, perm)
-        dvT_acc = jax.lax.ppermute(dvT_acc, axis_name, perm)
-        return (dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp)
+        return (
+            dqT + dqb.astype(jnp.float32),
+            dkT_acc + dkb.astype(jnp.float32),
+            dvT_acc + dvb.astype(jnp.float32),
+        )
 
     dq0 = jnp.zeros(qT.shape, jnp.float32)
     dk0 = jnp.zeros(kT0.shape, jnp.float32)
     dv0 = jnp.zeros(vT0.shape, jnp.float32)
-    dqT, dkT, dvT, _, _, _ = jax.lax.fori_loop(
-        0, n, hop, (dq0, dk0, dv0, kT0, vT0, kv_pos)
-    )
+    if _ring_overlap():
+        def hop(i, carry):
+            dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp = carry
+            # K/V rotation issued BEFORE the backward kernels (depends
+            # only on the carry — hides behind the block compute). The
+            # dk/dv accumulators can only move AFTER this hop's adds:
+            # they ride the ring with the chunk, n permutes total, so
+            # each shard's accumulated gradient lands back home.
+            kT_nxt = jax.lax.ppermute(kT_cur, axis_name, perm)
+            vT_nxt = jax.lax.ppermute(vT_cur, axis_name, perm)
+            kvp_nxt = jax.lax.ppermute(kvp, axis_name, perm)
+            dqT, dkT_acc, dvT_acc = block_grads(
+                dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp
+            )
+            dkT_acc = jax.lax.ppermute(dkT_acc, axis_name, perm)
+            dvT_acc = jax.lax.ppermute(dvT_acc, axis_name, perm)
+            return (dqT, dkT_acc, dvT_acc, kT_nxt, vT_nxt, kvp_nxt)
+
+        dqT, dkT, dvT, kT_l, vT_l, kvp_l = jax.lax.fori_loop(
+            0, n - 1, hop, (dq0, dk0, dv0, kT0, vT0, kv_pos)
+        )
+        # Final chunk: grads computed without the wasted K/V rotation;
+        # the accumulators take their n-th hop home.
+        dqT, dkT, dvT = block_grads(dqT, dkT, dvT, kT_l, vT_l, kvp_l)
+        dkT = jax.lax.ppermute(dkT, axis_name, perm)
+        dvT = jax.lax.ppermute(dvT, axis_name, perm)
+    else:
+        def hop(i, carry):
+            dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp = carry
+            dqT, dkT_acc, dvT_acc = block_grads(
+                dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp
+            )
+            # dk/dv accumulators ride the ring WITH k/v: after n hops
+            # each shard's accumulated gradient is back on the shard
+            # that owns it.
+            kT_cur = jax.lax.ppermute(kT_cur, axis_name, perm)
+            vT_cur = jax.lax.ppermute(vT_cur, axis_name, perm)
+            kvp = jax.lax.ppermute(kvp, axis_name, perm)
+            dkT_acc = jax.lax.ppermute(dkT_acc, axis_name, perm)
+            dvT_acc = jax.lax.ppermute(dvT_acc, axis_name, perm)
+            return (dqT, dkT_acc, dvT_acc, kT_cur, vT_cur, kvp)
+
+        dqT, dkT, dvT, _, _, _ = jax.lax.fori_loop(
+            0, n, hop, (dq0, dk0, dv0, kT0, vT0, kv_pos)
+        )
     return (
         dqT.transpose(0, 2, 1, 3).astype(q.dtype),
         dkT.transpose(0, 2, 1, 3).astype(k.dtype),
@@ -354,6 +441,33 @@ def _ring_impl(impl: Optional[str]) -> str:
             f"'xla') — refusing to silently fall back"
         )
     return impl
+
+
+def _axis_size(axis_name) -> int:
+    """Static mesh-axis size inside shard_map: jax.lax.axis_size where
+    it exists, the psum-of-unit idiom (resolved to a Python int at
+    trace time) on older releases."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def _shard_map_compat(body, mesh, in_specs, out_specs):
+    """jax.shard_map(check_vma=False) where the public API exists,
+    jax.experimental.shard_map.shard_map(check_rep=False) on older
+    releases (the replication/VMA check was renamed across versions —
+    both forms disable it, which the ring's manual collectives need)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 def make_ring_attention(
@@ -396,12 +510,11 @@ def make_ring_attention(
                 q, k, v, qp, kp, axis_name, causal, softmax_scale
             )
 
-        return jax.shard_map(
+        return _shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec),
             out_specs=q_spec,
-            check_vma=False,
         )(q, k, v, q_positions, kv_positions)
 
     # The pallas path's ring-level custom VJP keeps O(s*d) residuals

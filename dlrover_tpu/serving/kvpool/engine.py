@@ -80,11 +80,35 @@ class _PagedSteps(NamedTuple):
 
 
 def _build_paged_decode(config, slots: int, max_blocks: int,
-                        block_size: int, counts):
+                        block_size: int, counts,
+                        quantized: bool = False):
     """[slots] tokens -> one decoded token per slot, ragged lengths,
-    cache gathered per layer through the block tables."""
+    cache gathered per layer through the block tables. ``quantized``:
+    int8 pools + per-(row, head) scale pools — the gather streams half
+    the KV bytes and the append quantizes each new row (ops/kv_quant);
+    dequantization folds into the attention math."""
     max_len = max_blocks * block_size
     kh, hd = config.n_kv_heads, config.head_dim
+
+    def _append_coords(tables, lengths, active):
+        # Per-slot append through the table. Non-active slots are
+        # redirected to the sentinel block: their garbage must never
+        # land in a block another slot may SHARE (the flat engine's
+        # own-row invisibility does not survive sharing). Active slots
+        # write their privately-owned cursor block (host COW-ensured).
+        write = jnp.minimum(lengths, max_len - 1)
+        blk = jnp.take_along_axis(
+            tables, (write // block_size)[:, None], axis=1
+        )[:, 0]
+        blk = jnp.where(active, blk, SENTINEL_BLOCK)
+        off = jnp.where(active, write % block_size, 0)
+        return blk, off
+
+    def _finish(x, params, rng, step_idx, temps, active, tokens):
+        logits = llama.unembed(config, params, x)[:, 0]   # [slots, V]
+        sub = jax.random.fold_in(rng, step_idx * 2)
+        nxt = gen_lib.sample_token(logits, sub, temps)
+        return jnp.where(active, nxt, tokens)
 
     def step(k, v, params, tables, lengths, tokens, active, temps,
              rng, step_idx):
@@ -104,34 +128,59 @@ def _build_paged_decode(config, slots: int, max_blocks: int,
         x, (k_news, v_news) = jax.lax.scan(
             body, x, (params["layers"], k, v)
         )
-        # Per-slot append through the table. Non-active slots are
-        # redirected to the sentinel block: their garbage must never
-        # land in a block another slot may SHARE (the flat engine's
-        # own-row invisibility does not survive sharing). Active slots
-        # write their privately-owned cursor block (host COW-ensured).
-        write = jnp.minimum(lengths, max_len - 1)
-        blk = jnp.take_along_axis(
-            tables, (write // block_size)[:, None], axis=1
-        )[:, 0]
-        blk = jnp.where(active, blk, SENTINEL_BLOCK)
-        off = jnp.where(active, write % block_size, 0)
+        blk, off = _append_coords(tables, lengths, active)
         k = k.at[:, blk, off].set(k_news[:, :, 0].astype(k.dtype))
         v = v.at[:, blk, off].set(v_news[:, :, 0].astype(v.dtype))
-        logits = llama.unembed(config, params, x)[:, 0]   # [slots, V]
-        sub = jax.random.fold_in(rng, step_idx * 2)
-        nxt = gen_lib.sample_token(logits, sub, temps)
-        nxt = jnp.where(active, nxt, tokens)
+        nxt = _finish(x, params, rng, step_idx, temps, active, tokens)
         return k, v, nxt
 
-    return step
+    def step_q8(k, v, ks, vs, params, tables, lengths, tokens, active,
+                temps, rng, step_idx):
+        from dlrover_tpu.ops.kv_quant import quantize_kv
+
+        counts["decode"] += 1  # traces only
+        positions = lengths[:, None]
+        x = llama.embed_tokens(config, params, tokens[:, None])
+
+        def body(carry, layer_in):
+            pl, k_c, v_c, ks_c, vs_c = layer_in
+            k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+            v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+            ks_view = ks_c[tables].reshape(slots, max_len, kh)
+            vs_view = vs_c[tables].reshape(slots, max_len, kh)
+            y, k_new, v_new = gen_lib._layer_decode_read_only(
+                config, pl, carry, positions, k_view, v_view, lengths,
+                k_scale=ks_view, v_scale=vs_view,
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v, ks, vs)
+        )
+        blk, off = _append_coords(tables, lengths, active)
+        kq, ks_rows = quantize_kv(k_news[:, :, 0])   # [L, slots, kh, hd]
+        vq, vs_rows = quantize_kv(v_news[:, :, 0])
+        k = k.at[:, blk, off].set(kq)
+        v = v.at[:, blk, off].set(vq)
+        ks = ks.at[:, blk, off].set(ks_rows)
+        vs = vs.at[:, blk, off].set(vs_rows)
+        nxt = _finish(x, params, rng, step_idx, temps, active, tokens)
+        return k, v, ks, vs, nxt
+
+    return step_q8 if quantized else step
 
 
 def _build_paged_prefill(config, max_blocks: int, block_size: int,
-                         chunk: int, counts):
+                         chunk: int, counts, quantized: bool = False):
     """One prompt chunk into ONE slot's blocks: gather the slot's
     logical cache through its table row, run the flat prefill body,
     scatter back only the touched blocks (shared untouched blocks are
-    never rewritten — the COW invariant)."""
+    never rewritten — the COW invariant). ``quantized``: the slot view
+    is dequantized for the (compute-bound) chunk forward and the
+    touched span re-quantized on the way out — per-(row, head)
+    round-to-nearest is IDEMPOTENT (the amax element always maps to
+    ±127), so rows below the chunk inside a touched block keep their
+    exact stored values."""
     L = config.n_layers
     kh, hd = config.n_kv_heads, config.head_dim
     max_len = max_blocks * block_size
@@ -140,11 +189,7 @@ def _build_paged_prefill(config, max_blocks: int, block_size: int,
     # (init enforces one of chunk % bs == 0 / bs % chunk == 0).
     n_touch = max(chunk // block_size, 1)
 
-    def prefill(k, v, params, tokens, table_row, start, n_valid, temp,
-                rng, step_idx):
-        counts["prefill"] += 1  # traces only
-        k_slot = k[:, table_row].reshape(L, 1, max_len, kh, hd)
-        v_slot = v[:, table_row].reshape(L, 1, max_len, kh, hd)
+    def _run_chunk(k_slot, v_slot, params, tokens, start):
         positions = (
             start + jnp.arange(chunk, dtype=jnp.int32)
         )[None, :]
@@ -158,38 +203,82 @@ def _build_paged_prefill(config, max_blocks: int, block_size: int,
             )
             return y, (k_c, v_c)
 
-        x, (k_slot, v_slot) = jax.lax.scan(
+        return jax.lax.scan(
             body, x, (params["layers"], k_slot, v_slot)
         )
-        # Scatter back ONLY the touched blocks. touched0*bs <= start
-        # and the touched span covers [start, start+chunk) exactly
-        # (chunk-aligned starts; see the divisibility contract), so
-        # shared UNtouched blocks are never rewritten.
+
+    def _touched(arr, start, head_shape):
+        # Slice the touched span [touched0*bs, +n_touch*bs) — it
+        # covers [start, start+chunk) exactly (chunk-aligned starts;
+        # see the divisibility contract), so shared UNtouched blocks
+        # are never rewritten.
         touched0 = start // block_size
-        seg_k = jax.lax.dynamic_slice(
-            k_slot, (0, 0, touched0 * block_size, 0, 0),
-            (L, 1, n_touch * block_size, kh, hd),
-        ).reshape(L, n_touch, block_size, kh, hd)
-        seg_v = jax.lax.dynamic_slice(
-            v_slot, (0, 0, touched0 * block_size, 0, 0),
-            (L, 1, n_touch * block_size, kh, hd),
-        ).reshape(L, n_touch, block_size, kh, hd)
-        ids = jax.lax.dynamic_slice(table_row, (touched0,), (n_touch,))
-        k = k.at[:, ids].set(seg_k.astype(k.dtype))
-        v = v.at[:, ids].set(seg_v.astype(v.dtype))
+        seg = jax.lax.dynamic_slice(
+            arr, (0, 0, touched0 * block_size) + (0,) * len(head_shape),
+            (L, 1, n_touch * block_size) + head_shape,
+        ).reshape((L, n_touch, block_size) + head_shape)
+        return seg, touched0
+
+    def _first_token(x, params, n_valid, temp, rng, step_idx):
         h = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
         logits = llama.unembed(config, params, h)[0, 0]    # [V]
         sub = jax.random.fold_in(rng, step_idx * 2 + 1)
-        first = gen_lib.sample_token(logits, sub, temp)
+        return gen_lib.sample_token(logits, sub, temp)
+
+    def prefill(k, v, params, tokens, table_row, start, n_valid, temp,
+                rng, step_idx):
+        counts["prefill"] += 1  # traces only
+        k_slot = k[:, table_row].reshape(L, 1, max_len, kh, hd)
+        v_slot = v[:, table_row].reshape(L, 1, max_len, kh, hd)
+        x, (k_slot, v_slot) = _run_chunk(
+            k_slot, v_slot, params, tokens, start
+        )
+        seg_k, touched0 = _touched(k_slot, start, (kh, hd))
+        seg_v, _ = _touched(v_slot, start, (kh, hd))
+        ids = jax.lax.dynamic_slice(table_row, (touched0,), (n_touch,))
+        k = k.at[:, ids].set(seg_k.astype(k.dtype))
+        v = v.at[:, ids].set(seg_v.astype(v.dtype))
+        first = _first_token(x, params, n_valid, temp, rng, step_idx)
         return k, v, first
 
-    return prefill
+    def prefill_q8(k, v, ks, vs, params, tokens, table_row, start,
+                   n_valid, temp, rng, step_idx):
+        from dlrover_tpu.ops.kv_quant import dequantize_kv, quantize_kv
+
+        counts["prefill"] += 1  # traces only
+        k_q = k[:, table_row].reshape(L, 1, max_len, kh, hd)
+        v_q = v[:, table_row].reshape(L, 1, max_len, kh, hd)
+        ks_slot = ks[:, table_row].reshape(L, 1, max_len, kh)
+        vs_slot = vs[:, table_row].reshape(L, 1, max_len, kh)
+        # f32 view, not compute_dtype: q*scale is exact in f32, so the
+        # round trip is idempotent and untouched rows inside touched
+        # blocks re-quantize to their exact stored (values, scale).
+        k_slot = dequantize_kv(k_q, ks_slot, jnp.float32)
+        v_slot = dequantize_kv(v_q, vs_slot, jnp.float32)
+        x, (k_slot, v_slot) = _run_chunk(
+            k_slot, v_slot, params, tokens, start
+        )
+        kq_new, ks_new = quantize_kv(k_slot)
+        vq_new, vs_new = quantize_kv(v_slot)
+        seg_k, touched0 = _touched(kq_new, start, (kh, hd))
+        seg_v, _ = _touched(vq_new, start, (kh, hd))
+        seg_ks, _ = _touched(ks_new, start, (kh,))
+        seg_vs, _ = _touched(vs_new, start, (kh,))
+        ids = jax.lax.dynamic_slice(table_row, (touched0,), (n_touch,))
+        k = k.at[:, ids].set(seg_k)
+        v = v.at[:, ids].set(seg_v)
+        ks = ks.at[:, ids].set(seg_ks)
+        vs = vs.at[:, ids].set(seg_vs)
+        first = _first_token(x, params, n_valid, temp, rng, step_idx)
+        return k, v, ks, vs, first
+
+    return prefill_q8 if quantized else prefill
 
 
-def _build_cow_copy(counts):
-    """Device block copy src -> dst (both K and V, all layers): the
-    copy-on-write primitive. src/dst are traced scalars — privatizing
-    any block never retraces."""
+def _build_cow_copy(counts, quantized: bool = False):
+    """Device block copy src -> dst (both K and V, all layers, plus
+    the scale pools for int8): the copy-on-write primitive. src/dst
+    are traced scalars — privatizing any block never retraces."""
 
     def cow(k, v, src, dst):
         counts["cow"] += 1  # traces only
@@ -197,29 +286,44 @@ def _build_cow_copy(counts):
         v = v.at[:, dst].set(v[:, src])
         return k, v
 
-    return cow
+    def cow_q8(k, v, ks, vs, src, dst):
+        counts["cow"] += 1  # traces only
+        k = k.at[:, dst].set(k[:, src])
+        v = v.at[:, dst].set(v[:, src])
+        ks = ks.at[:, dst].set(ks[:, src])
+        vs = vs.at[:, dst].set(vs[:, src])
+        return k, v, ks, vs
+
+    return cow_q8 if quantized else cow
 
 
 @functools.lru_cache(maxsize=16)
 def _paged_steps(
     config: llama.TpuLMConfig, slots: int, num_blocks: int,
     max_blocks: int, block_size: int, chunk: int,
+    kv_dtype: str = "fp",
 ) -> _PagedSteps:
     """Compiled once per shape key, shared across engines (the flat
     engine's lru_cache discipline). Pools donated; tables/lengths/ids
-    all plain traced arguments."""
+    all plain traced arguments. ``kv_dtype`` "int8" programs also
+    donate the scale pools."""
     counts = {"prefill": 0, "decode": 0, "cow": 0}
+    quantized = kv_dtype == "int8"
+    pool_args = (0, 1, 2, 3) if quantized else (0, 1)
     decode = jax.jit(
         _build_paged_decode(config, slots, max_blocks, block_size,
-                            counts),
-        donate_argnums=(0, 1),
+                            counts, quantized=quantized),
+        donate_argnums=pool_args,
     )
     prefill = jax.jit(
         _build_paged_prefill(config, max_blocks, block_size, chunk,
-                             counts),
-        donate_argnums=(0, 1),
+                             counts, quantized=quantized),
+        donate_argnums=pool_args,
     )
-    cow = jax.jit(_build_cow_copy(counts), donate_argnums=(0, 1))
+    cow = jax.jit(
+        _build_cow_copy(counts, quantized=quantized),
+        donate_argnums=pool_args,
+    )
     return _PagedSteps(prefill=prefill, decode=decode, cow=cow,
                        trace_counts=counts)
 
@@ -232,7 +336,11 @@ class PagedServingEngine(ServingEngine):
     programs differ. ``num_blocks`` defaults to exactly the flat
     engine's HBM budget (``slots * max_len / block_size`` + sentinel);
     pass fewer blocks and MORE slots for the oversubscribed capacity
-    win the bench measures."""
+    win the bench measures. ``kv_cache_dtype="int8"`` stores the pool
+    as int8 with per-(row, head) f32 scale pools (ops/kv_quant, §33):
+    ~1.94x the blocks fit the same HBM, dequantization folds into the
+    attention math, and COW/prefix/preemption machinery is unchanged
+    (shared blocks share their scales)."""
 
     def __init__(
         self,
@@ -251,7 +359,13 @@ class PagedServingEngine(ServingEngine):
         registry=None,
         max_requeues: int = 3,
         slo_classes=None,
+        kv_cache_dtype: str = "fp",
     ):
+        if kv_cache_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {kv_cache_dtype!r} not in "
+                f"('fp', 'int8')"
+            )
         if max_len % block_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of block_size "
@@ -265,6 +379,7 @@ class PagedServingEngine(ServingEngine):
                 f"prefill_chunk {prefill_chunk} and block_size "
                 f"{block_size} must divide one another"
             )
+        self.kv_cache_dtype = kv_cache_dtype
         self.block_size = block_size
         self.max_blocks = max_len // block_size
         if num_blocks is None:
@@ -295,12 +410,16 @@ class PagedServingEngine(ServingEngine):
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefix_hit_blocks = 0
+        # The base __init__ builds the value pools via _fresh_pool();
+        # the int8 scale pools pair up right after it returns (nothing
+        # in between touches them).
         super().__init__(
             config, params, slots, max_len,
             prefill_chunk=prefill_chunk, token_budget=token_budget,
             drain_mode=drain_mode, rng=rng, registry=registry,
             max_requeues=max_requeues, slo_classes=slo_classes,
         )
+        self._kscale, self._vscale = self._fresh_scales()
         # Block watermark: only admit a request the pool can hold
         # (prompt + first decode block) counting evictable cache as
         # free — otherwise bursty arrivals thrash preemptions, each
@@ -311,38 +430,86 @@ class PagedServingEngine(ServingEngine):
         # paged shapes, and re-settle the retrace snapshot.
         self._steps = _paged_steps(
             config, slots, self.num_blocks, self.max_blocks,
-            block_size, prefill_chunk,
+            block_size, prefill_chunk, kv_dtype=kv_cache_dtype,
         )
         self._trace_snapshot = dict(self._steps.trace_counts)
-        # K+V bytes per block, for the HBM-in-use gauge.
+        # K+V bytes per block, for the HBM-in-use gauge: int8 pools
+        # pay 1 byte/element + one f32 scale per (row, head) — the
+        # 1.94x-per-token capacity lever the equal-HBM bench exploits.
+        from dlrover_tpu.ops.kv_quant import bytes_per_head_row
+
         self._block_bytes = int(
             2 * config.n_layers * block_size * config.n_kv_heads
-            * config.head_dim * jnp.dtype(config.compute_dtype).itemsize
+            * bytes_per_head_row(
+                config.head_dim, kv_cache_dtype,
+                jnp.dtype(config.compute_dtype).itemsize,
+            )
         )
         self.metrics.kv_blocks_total.set(self._allocator.managed)
 
     # ---- pool construction / programs --------------------------------------
+
+    @property
+    def _quantized(self) -> bool:
+        return self.kv_cache_dtype == "int8"
 
     def _fresh_pool(self):
         shape = (
             self.config.n_layers, self.num_blocks, self.block_size,
             self.config.n_kv_heads, self.config.head_dim,
         )
-        dtype = self.config.compute_dtype
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        if self._quantized:
+            return jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8)
+        return (
+            jnp.zeros(shape, self.config.compute_dtype),
+            jnp.zeros(shape, self.config.compute_dtype),
+        )
+
+    def _pools(self):
+        """The donated-pool argument tuple every compiled program
+        leads with: (k, v) for fp, (k, v, k_scale, v_scale) for int8.
+        Call sites splat this and hand the returned tuple back to
+        :meth:`_set_pools` — ONE argument list per program, whatever
+        the dtype."""
+        if self._quantized:
+            return (self._k, self._v, self._kscale, self._vscale)
+        return (self._k, self._v)
+
+    def _set_pools(self, pools) -> None:
+        if self._quantized:
+            self._k, self._v, self._kscale, self._vscale = pools
+        else:
+            self._k, self._v = pools
+
+    def _fresh_scales(self):
+        """(k_scale, v_scale) pools for the int8 cache — (None, None)
+        for fp. Every value-pool rebuild site (init, warmup,
+        step-error recovery) pairs a _fresh_pool() call with this one
+        so value and scale pools can never be mismatched."""
+        if not self._quantized:
+            return None, None
+        shape = (
+            self.config.n_layers, self.num_blocks, self.block_size,
+            self.config.n_kv_heads,
+        )
+        return (
+            jnp.zeros(shape, jnp.float32),
+            jnp.zeros(shape, jnp.float32),
+        )
 
     def warmup(self) -> None:
         """Compile all three paged programs on throwaway state, then
         rebuild the pool — first real request pays no compile."""
         chunk = np.zeros((1, self.prefill_chunk), np.int32)
-        k, v, first = self._steps.prefill(
-            self._k, self._v, self._params, jnp.asarray(chunk),
+        pools = self._pools()
+        *pools, first = self._steps.prefill(
+            *pools, self._params, jnp.asarray(chunk),
             jnp.zeros(self.max_blocks, jnp.int32),
             np.int32(0), np.int32(1), np.float32(0.0),
             self._rng, np.int32(0),
         )
-        k, v, nxt = self._steps.decode(
-            k, v, self._params,
+        *pools, nxt = self._steps.decode(
+            *pools, self._params,
             jnp.asarray(np.zeros((self.slots, self.max_blocks),
                                  np.int32)),
             jnp.asarray(np.zeros(self.slots, np.int32)),
@@ -351,10 +518,11 @@ class PagedServingEngine(ServingEngine):
             jnp.asarray(np.zeros(self.slots, np.float32)),
             self._rng, np.int32(0),
         )
-        k, v = self._steps.cow(k, v, np.int32(0), np.int32(0))
-        jax.block_until_ready(v)
-        del k, v
+        pools = self._steps.cow(*pools, np.int32(0), np.int32(0))
+        jax.block_until_ready(pools[-1])
+        del pools
         self._k, self._v = self._fresh_pool()
+        self._kscale, self._vscale = self._fresh_scales()
         self._trace_snapshot = dict(self._steps.trace_counts)
 
     # ---- block bookkeeping -------------------------------------------------
@@ -452,9 +620,9 @@ class PagedServingEngine(ServingEngine):
         if self._allocator.refcount(old) <= 1:
             return
         new = self._alloc_blocks(1, req)[0]
-        self._k, self._v = self._steps.cow(
-            self._k, self._v, np.int32(old), np.int32(new)
-        )
+        self._set_pools(self._steps.cow(
+            *self._pools(), np.int32(old), np.int32(new)
+        ))
         self._allocator.decref(old)
         self._allocator.cow_copies_total += 1
         blocks[logical_idx] = new
@@ -512,8 +680,9 @@ class PagedServingEngine(ServingEngine):
     def _reset_pool(self) -> None:
         # A failed step may have invalidated the donated pools: the
         # device blocks AND everything that points at them (allocator,
-        # prefix cache, tables) restart from scratch.
+        # prefix cache, tables, int8 scale pools) restart from scratch.
         self._k, self._v = self._fresh_pool()
+        self._kscale, self._vscale = self._fresh_scales()
         self._allocator = BlockAllocator(self.num_blocks, reserved=1)
         if self._cache is not None:
             self._cache = PrefixCache(
@@ -551,13 +720,14 @@ class PagedServingEngine(ServingEngine):
             self._privatize(req, idx)
         chunk = np.zeros((1, c), np.int32)
         chunk[0, :n_valid] = req.prompt[start:start + n_valid]
-        self._k, self._v, first = self._steps.prefill(
-            self._k, self._v, self._params, jnp.asarray(chunk),
+        *pools, first = self._steps.prefill(
+            *self._pools(), self._params, jnp.asarray(chunk),
             jnp.asarray(self._tables[req.slot]),
             np.int32(start), np.int32(n_valid),
             np.float32(req.temperature), self._rng,
             np.int32(self._step_idx),
         )
+        self._set_pools(pools)
         req.prefill_pos += n_valid
         self._lengths[req.slot] = req.prefill_pos
         self.metrics.tokens.inc(n_valid, kind="prefill")
@@ -599,13 +769,13 @@ class PagedServingEngine(ServingEngine):
         active = np.zeros(self.slots, bool)
         for r in decoding:
             active[r.slot] = True
-        self._k, self._v, nxt = self._steps.decode(
-            self._k, self._v, self._params,
-            jnp.asarray(self._tables),
+        *pools, nxt = self._steps.decode(
+            *self._pools(), self._params, jnp.asarray(self._tables),
             jnp.asarray(self._lengths), jnp.asarray(self._tokens),
             jnp.asarray(active), jnp.asarray(self._temps),
             self._rng, np.int32(self._step_idx),
         )
+        self._set_pools(pools)
         nxt = np.asarray(jax.device_get(nxt))
         for r in decoding:
             self._lengths[r.slot] += 1

@@ -70,3 +70,10 @@ def pytest_configure(config):
         "docs/DESIGN.md §32; fast lane runs the 64-worker smoke, the "
         "1k-worker ramp is slow-lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "kernels: Pallas kernel parity suites (fused MoE dispatch, "
+        "int8-KV decode, paged decode) — docs/DESIGN.md §33; run in "
+        "interpret mode so the CPU tier-1 lane covers kernel logic "
+        "without a TPU",
+    )

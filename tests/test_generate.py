@@ -208,12 +208,14 @@ def test_temperature_change_does_not_retrace(tiny):
 
 def test_decode_attn_env_typo_warns(monkeypatch):
     """An unrecognized DLROVER_TPU_DECODE_ATTN value must warn (naming
-    the accepted values) instead of silently running xla. A handler is
-    attached to the module logger directly: the repo's shared logging
-    setup turns off propagation, so caplog's root handler would not
-    see the record in a full-suite run."""
+    the accepted values) instead of silently running xla. The knob now
+    goes through the shared env_utils.resolve_env_choice, so the
+    handler attaches to THAT module's logger (the repo's shared
+    logging setup turns off propagation, so caplog's root handler
+    would not see the record in a full-suite run)."""
     import logging
 
+    from dlrover_tpu.common import env_utils
     from dlrover_tpu.models import generate as g
 
     records = []
@@ -222,12 +224,12 @@ def test_decode_attn_env_typo_warns(monkeypatch):
         def emit(self, record):
             records.append(record.getMessage())
 
-    log = logging.getLogger(g.__name__)
+    log = logging.getLogger(env_utils.__name__)
     handler = Grab(level=logging.WARNING)
     log.addHandler(handler)
     try:
         monkeypatch.setenv("DLROVER_TPU_DECODE_ATTN", "palas")
-        g._WARNED_ATTN_VALUES.clear()
+        env_utils._WARNED_CHOICES.clear()
         assert g._decode_attn_impl() == "xla"
         assert any("palas" in m and "pallas" in m for m in records)
         # Warn once per distinct value, not per call.

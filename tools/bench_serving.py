@@ -268,6 +268,15 @@ def run_paged_ab(
       shared-prefix requests that hit vs missed the cache;
     - token-exactness: every request's greedy tokens must MATCH the
       flat engine's, asserted, plus zero retraces after warmup.
+
+    A third leg reruns the paged engine with the int8 KV cache at the
+    SAME HBM byte budget (per-(row, head) scales counted): the pool
+    holds ~2x the blocks and the engine gets 2x the fp paged leg's
+    logical slots (= 4x the flat baseline's), so
+    ``kv_effective_slots_int8`` can record the §33 capacity doubling;
+    ``int8_token_match`` is the per-request full-sequence agreement
+    with the fp paged engine (quantization may legitimately flip
+    near-tie logits — the match rate is reported, not asserted).
     """
     cfg = llama.tiny_config()
     params, _ = llama.init_params(cfg, __import__("jax").random.key(0))
@@ -324,9 +333,50 @@ def run_paged_ab(
         r.ttft_s for r in shared
         if r.prefix_hit_blocks == 0 and r.ttft_s is not None
     ]
+    # --- int8 leg: equal HBM bytes, ~2x blocks, 2x logical slots ----
+    from dlrover_tpu.ops.kv_quant import bytes_per_head_row
+
+    num_blocks_fp = slots * max_len // block_size + 1
+    fp_block_bytes = paged._block_bytes
+    int8_block_bytes = int(
+        2 * cfg.n_layers * block_size * cfg.n_kv_heads
+        * bytes_per_head_row(cfg.head_dim, "int8")
+    )
+    num_blocks_int8 = max(
+        (num_blocks_fp - 1) * fp_block_bytes // int8_block_bytes + 1,
+        max_len // block_size + 1,
+    )
+    int8_reg = MetricsRegistry()
+    paged8 = PagedServingEngine(
+        cfg, params, slots=4 * slots, max_len=max_len,
+        prefill_chunk=prefill_chunk, block_size=block_size,
+        num_blocks=int(num_blocks_int8), registry=int8_reg,
+        kv_cache_dtype="int8",
+    )
+    paged8.warmup()
+    warm8 = dict(paged8.trace_counts)
+    int8_m, int8_reqs = drive(paged8, workload, return_finished=True)
+    retraces8 = sum(paged8.trace_counts.values()) - sum(warm8.values())
+    assert retraces8 == 0, (
+        f"int8 paged steps retraced {retraces8}x after warmup"
+    )
+    paged8.check_block_invariants()
+    int8_match = sum(
+        1 for f, p in zip(paged_reqs, int8_reqs) if f.tokens == p.tokens
+    ) / max(len(paged_reqs), 1)
+
     return {
         "kv_effective_slots": paged_m["peak_active_slots"],
         "flat_effective_slots": flat_m["peak_active_slots"],
+        "kv_effective_slots_int8": int8_m["peak_active_slots"],
+        "int8_vs_fp_tokens_per_s": round(
+            int8_m["tokens_per_s"]
+            / max(paged_m["tokens_per_s"], 1e-9), 3
+        ),
+        "int8_blocks_at_equal_hbm": int(num_blocks_int8),
+        "fp_blocks_at_equal_hbm": num_blocks_fp,
+        "int8_token_match": round(int8_match, 3),
+        "int8_retraces_after_warmup": retraces8,
         "paged_vs_flat_tokens_per_s": round(
             paged_m["tokens_per_s"]
             / max(flat_m["tokens_per_s"], 1e-9), 3

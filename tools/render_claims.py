@@ -70,6 +70,11 @@ def extract(text: str, key: str):
     return float(m.group(1)) if m else None
 
 
+def extract_str(text: str, key: str):
+    m = re.search(rf'\\?"{key}\\?": \\?"([A-Za-z0-9_-]+)\\?"', text)
+    return m.group(1) if m else None
+
+
 def fmt(v, nd=2):
     if v is None:
         return "n/a"
@@ -134,6 +139,27 @@ def render_block(path: str) -> str:
         ("Profiler capture overhead (60s cadence)",
          g("profiler_overhead_pct"),
          f"{fmt(g('profiler_overhead_pct'), 3)}%"),
+        # §33 raw-speed kernel campaign rows (absent until a bench
+        # round measures them on hardware).
+        # Gated on the artifact's RECORDED dispatch impl: pre-§33
+        # artifacts (no key) and gmm A/B rounds both carry a
+        # moe_dropless_mfu_active_pct that was NOT measured on the
+        # fused kernel and must not render under its label.
+        ("MoE dropless active-MFU (fused sort-dispatch kernel)",
+         (g("moe_dropless_mfu_active_pct")
+          if extract_str(text, "moe_dispatch_impl") == "fused"
+          else None),
+         f"{fmt(g('moe_dropless_mfu_active_pct'))}%"),
+        ("Decode vs HBM roofline with int8 KV (batch 8)",
+         g("decode_vs_roofline_int8"),
+         f"{fmt(g('decode_vs_roofline_int8'), 2)}x"),
+        ("Paged-KV effective slots, int8 at equal HBM",
+         g("serving_kv_effective_slots_int8"),
+         f"{fmt(g('serving_kv_effective_slots_int8'), 0)}"
+         f" (fp16: {fmt(g('serving_kv_effective_slots'), 0)})"),
+        ("Ring-attention overlap schedule speedup (s=8192)",
+         g("ring_overlap_speedup_s8192"),
+         f"{fmt(g('ring_overlap_speedup_s8192'), 3)}x"),
     ]
     origin = (
         "full in-round measurement written by bench.py"
