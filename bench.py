@@ -1514,8 +1514,33 @@ def autoscale_phase():
     import bench_autoscale
 
     r = bench_autoscale.run_bench()
-    return {f"autoscale_{k}" if not k.startswith("static_") else k: v
-            for k, v in r.items()}
+    # The §34 keys keep their canonical names (the KEEP_KEYS contract
+    # names them unprefixed); everything else — including the legacy
+    # goodput_frac/goodput_gain pair — still gets the autoscale_
+    # prefix so autoscale_goodput_frac keeps existing.
+    _canonical = {"goodput_attributed_frac", "goodput_causes"}
+    return {
+        k if (k.startswith(("static_", "whatif_")) or k in _canonical)
+        else f"autoscale_{k}": v
+        for k, v in r.items()
+    }
+
+
+def whatif_phase():
+    """What-if replay machinery (tools/whatif.py, §34): a synthetic
+    deterministic recording (fake clocks, no sleeps) is written through
+    the real SignalRecorder, loaded, replayed through the recorded
+    PolicyConfig (identity asserted) and a candidate spread, ranked
+    under the goodput model. Reports replay throughput (snapshots/s) —
+    the budget a learned brain has for offline policy search. Host-only,
+    jax-free — runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import whatif
+
+    return whatif.run_bench()
 
 
 def rescale_phase():
@@ -1737,6 +1762,10 @@ _KEEP_KEYS = {
     "rescale_to_first_step_s", "rescale_invariants",
     "autoscale_goodput_frac", "static_goodput_frac",
     "autoscale_decisions_total", "autoscale_time_to_mitigate_s",
+    # §34 decision-outcome plane: replay throughput, the identity
+    # invariant, and the per-cause attribution coverage headline.
+    "whatif_replay_snapshots_per_s", "whatif_identity_ok",
+    "goodput_attributed_frac",
     "cp_max_rps", "cp_cpu_s_per_1k_rpcs", "cp_quorum_1024_s",
     "cp_invariants",
     "fleet_tokens_per_s", "fleet_speedup_vs_single",
@@ -1768,7 +1797,9 @@ _DROP_ORDER = (
     r"|kv_(preemptions|cow))",
     r"^soak_(faults|episodes|deaths|mttr_max)",
     r"^(autoscale_(ckpt|stall|serve|fleet|dry_run|deaths|invariants"
-    r"|actuations|mitigate|goodput_gain)|static_(stall|serve))",
+    r"|actuations|mitigate|goodput_gain|outcome)|static_(stall|serve))",
+    r"^(whatif_(snapshots|recorded|perturbed|outcomes|load|candidates"
+    r"|best|first|soak)|goodput_causes)",
     r"^cp_(workers|rpcs_total|inflight|dispatch|shed_|span_agree"
     r"|quorum_(8|64|256)_s)",
     r"^rescale_(plans|deaths|events|goodput|barrier|restore"
@@ -1982,6 +2013,10 @@ def main():
         run_phase(
             result, "autoscale", autoscale_phase, est_s=60, cap_s=240
         )
+        # What-if replay machinery: record→load→identity→rank over a
+        # synthetic deterministic stream (fake clocks); reports replay
+        # snapshots/s. Host-only, every platform.
+        run_phase(result, "whatif", whatif_phase, est_s=20, cap_s=90)
         # Control-plane saturation: 1k sim workers vs one master over
         # the real HTTP transport (max RPCs/s, CPU per 1k RPCs,
         # time-to-quorum vs world size, shed-law invariants).
@@ -2080,6 +2115,8 @@ def prev_round_diff(now: dict) -> dict:
         "decode_ms_per_token_int8",
         "serving_kv_effective_slots",
         "ring_inner_speedup_s8192",
+        "whatif_replay_snapshots_per_s",
+        "goodput_attributed_frac",
     )
     for path in sorted(files, key=round_no, reverse=True):
         try:

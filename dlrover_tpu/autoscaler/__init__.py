@@ -22,6 +22,23 @@ from dlrover_tpu.autoscaler.actuator import (
     TrainWorldActuator,
 )
 from dlrover_tpu.autoscaler.loop import AutoScaler, BrainPrior
+from dlrover_tpu.autoscaler.recorder import (
+    RECORD_ENV,
+    Recording,
+    SignalRecorder,
+    load_recording,
+    recorder_from_env,
+)
+from dlrover_tpu.autoscaler.replay import (
+    CostModel,
+    ReplayMismatch,
+    assert_replay_identity,
+    diff_ledgers,
+    rank_policies,
+    replay_policy,
+    replay_recording,
+    score_ledger,
+)
 from dlrover_tpu.autoscaler.policy import (
     ACTIONS,
     EVICT_STRAGGLER,
@@ -51,6 +68,19 @@ from dlrover_tpu.autoscaler.signals import (
 __all__ = [
     "AutoScaler",
     "BrainPrior",
+    "SignalRecorder",
+    "Recording",
+    "load_recording",
+    "recorder_from_env",
+    "RECORD_ENV",
+    "CostModel",
+    "ReplayMismatch",
+    "assert_replay_identity",
+    "diff_ledgers",
+    "rank_policies",
+    "replay_policy",
+    "replay_recording",
+    "score_ledger",
     "SignalBus",
     "SignalSnapshot",
     "FaultHistory",

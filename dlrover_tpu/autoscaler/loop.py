@@ -25,9 +25,19 @@ from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.autoscaler.policy import (
     DecisionLedger,
+    EVICT_STRAGGLER,
+    GROW_FLEET,
+    GROW_WORLD,
     RulePolicy,
     ScaleDecision,
     SEED_WORLD,
+    SET_CKPT_INTERVAL,
+    SHRINK_FLEET,
+    SHRINK_WORLD,
+)
+from dlrover_tpu.autoscaler.recorder import (
+    SignalRecorder,
+    recorder_from_env,
 )
 from dlrover_tpu.autoscaler.signals import SignalBus, SignalSnapshot
 from dlrover_tpu.common.log import logger
@@ -65,6 +75,32 @@ def _metrics(registry=None):
             "autoscaler_ckpt_interval_s",
             "checkpoint cadence the autoscaler currently recommends",
         ),
+        # Outcome-attribution families: realized effects backfilled
+        # onto ledger entries after each decision's attribution window.
+        "outcome_total": reg.counter(
+            "autoscaler_decision_outcome_total",
+            "decision outcomes attributed, by action and verdict",
+            labelnames=("action", "verdict"),
+        ),
+        "outcome_goodput_delta": reg.gauge(
+            "autoscaler_decision_outcome_goodput_delta",
+            "goodput change over the newest attributed window, by action",
+            labelnames=("action",),
+        ),
+        "outcome_effect": reg.gauge(
+            "autoscaler_decision_outcome_effect",
+            "action-specific primary effect of the newest attributed "
+            "decision (score drop, backlog drain/s, net saved s/h)",
+            labelnames=("action",),
+        ),
+        "outcome_missed": reg.counter(
+            "autoscaler_decision_outcome_missed_total",
+            "outcome backfills whose ledger entry was already evicted",
+        ),
+        "outcome_pending": reg.gauge(
+            "autoscaler_decision_outcome_pending",
+            "actuated decisions still inside their attribution window",
+        ),
     }
 
 
@@ -85,6 +121,8 @@ class AutoScaler:
         registry=None,
         brain_prior: Optional["BrainPrior"] = None,
         job_name: str = "",
+        recorder: Optional[SignalRecorder] = None,
+        attribution_window_s: Optional[float] = None,
     ):
         self.bus = bus
         self.policy = policy or RulePolicy()
@@ -97,6 +135,24 @@ class AutoScaler:
         self._m["dry_run"].set(1.0 if dry_run else 0.0)
         self._brain = brain_prior
         self._job_name = job_name
+        # Durable signal recording (§34): explicit recorder, or armed
+        # from DLROVER_TPU_AUTOSCALE_RECORD the way subprocess workers
+        # arm the fault plane. The policy config is recorded up front —
+        # the replay identity invariant replays exactly this config.
+        self.recorder = recorder if recorder is not None \
+            else recorder_from_env()
+        if self.recorder is not None:
+            self.recorder.record_policy(self.policy.config.to_dict())
+        # Outcome attribution: after an actuated decision the loop
+        # watches this many seconds of SNAPSHOT time (clockless — same
+        # timestamps the policy rules use) and backfills the realized
+        # effect onto the ledger entry. Default: three decision
+        # intervals, enough for the actuation to show in the signals.
+        self.attribution_window_s = (
+            attribution_window_s if attribution_window_s is not None
+            else max(3.0 * interval_s, 1e-6)
+        )
+        self._pending_outcomes: List[ScaleDecision] = []
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seeded = False
@@ -120,6 +176,12 @@ class AutoScaler:
         it on the cadence."""
         self._m["ticks"].inc()
         snap = self.bus.sample()
+        if self.recorder is not None:
+            self.recorder.record_snapshot(snap)
+        # Outcomes first: an attribution window that closes THIS tick
+        # is measured against this snapshot, before any new decision
+        # perturbs the signals again.
+        self._resolve_outcomes(snap)
         if not self._seeded:
             self._seeded = True
             self._seed_from_brain(snap)
@@ -166,6 +228,14 @@ class AutoScaler:
             # recommendation channel for deployments with no push path.
             self._m["ckpt_interval"].set(float(decision.target))
         self.ledger.append(decision)
+        if self.recorder is not None:
+            # After actuation, so the record carries the result.
+            self.recorder.record_decision(decision)
+        if decision.outcome == "actuated":
+            self._pending_outcomes.append(decision)
+            self._m["outcome_pending"].set(
+                float(len(self._pending_outcomes))
+            )
         if span is not None:
             span.set_attr("outcome", decision.outcome)
             span.set_attr("reason", decision.reason[:200])
@@ -178,6 +248,159 @@ class AutoScaler:
             decision.seq, decision.action, decision.target,
             decision.reason, decision.outcome,
         )
+
+    # ---- outcome attribution (§34) -----------------------------------------
+
+    def _resolve_outcomes(self, snap: SignalSnapshot,
+                          force: bool = False):
+        """Close every attribution window that has elapsed by SNAPSHOT
+        time and backfill the realized effect onto the ledger entry
+        (plus the recorder and the outcome metric families). ``force``
+        closes everything — the stop() path, where a truncated window
+        beats an unannotated decision."""
+        still_open: List[ScaleDecision] = []
+        for decision in self._pending_outcomes:
+            window = self._window_s(decision, snap)
+            if not force and window < self.attribution_window_s:
+                still_open.append(decision)
+                continue
+            realized = self._realized_effect(decision, snap)
+            if force and window < self.attribution_window_s:
+                realized["window_truncated"] = True
+            if not self.ledger.attach_outcome(decision.seq, realized):
+                self._m["outcome_missed"].inc()
+            if self.recorder is not None:
+                self.recorder.record_outcome(decision.seq, realized)
+            verdict = str(realized.get("verdict", "neutral"))
+            self._m["outcome_total"].inc(
+                action=decision.action, verdict=verdict
+            )
+            if realized.get("goodput_delta") is not None:
+                self._m["outcome_goodput_delta"].set(
+                    float(realized["goodput_delta"]),
+                    action=decision.action,
+                )
+            if realized.get("effect") is not None:
+                self._m["outcome_effect"].set(
+                    float(realized["effect"]), action=decision.action
+                )
+        self._pending_outcomes = still_open
+        self._m["outcome_pending"].set(float(len(still_open)))
+
+    @staticmethod
+    def _window_s(decision: ScaleDecision,
+                  snap: SignalSnapshot) -> float:
+        """Elapsed snapshot time since the decision — on the MONOTONIC
+        stamp pair when both carry one (a wall-clock step mid-window
+        must not close it early or hold it open), wall otherwise."""
+        if decision.mono and snap.mono:
+            return snap.mono - decision.mono
+        return snap.ts - decision.ts
+
+    def _realized_effect(self, decision: ScaleDecision,
+                         snap: SignalSnapshot) -> Dict[str, object]:
+        """Measure what actually happened across the window: the
+        decision's own triggering snapshot is the before, ``snap`` the
+        after. ``effect`` is the action-specific primary number the
+        verdict is read from (positive = the decision helped)."""
+        before = decision.signals
+        after = snap.values
+
+        def b(key, default=None):
+            return before.get(key, default)
+
+        def a(key, default=None):
+            return after.get(key, default)
+
+        window = max(self._window_s(decision, snap), 1e-9)
+        out: Dict[str, object] = {
+            "window_s": round(window, 6),
+            "measured_at_seq": snap.seq,
+        }
+        gp_b, gp_a = b("perf.goodput"), a("perf.goodput")
+        if gp_b is not None and gp_a is not None:
+            out["goodput_before"] = round(float(gp_b), 6)
+            out["goodput_after"] = round(float(gp_a), 6)
+            out["goodput_delta"] = round(float(gp_a) - float(gp_b), 6)
+        effect: Optional[float] = None
+        if decision.action == EVICT_STRAGGLER:
+            rank = decision.target
+
+            def score_in(values):
+                scores = values.get("perf.straggler_scores") or {}
+                return float(scores.get(
+                    rank, scores.get(str(rank), 1.0)
+                ))
+
+            sb, sa = score_in(before), score_in(after)
+            out["straggler_score_before"] = round(sb, 4)
+            out["straggler_score_after"] = round(sa, 4)
+            flagged_after = [
+                int(r) for r in (a("perf.straggler_ranks") or [])
+            ]
+            out["straggler_cleared"] = int(rank) not in flagged_after
+            effect = sb - sa
+        elif decision.action in (GROW_FLEET, SHRINK_FLEET):
+            qb = float(b("fleet.queue_depth", 0.0) or 0.0)
+            qa = float(a("fleet.queue_depth", 0.0) or 0.0)
+            out["queue_before"] = round(qb, 2)
+            out["queue_after"] = round(qa, 2)
+            # Positive drain = backlog shrank over the window; a shrink
+            # that makes the queue grow reads as a regression too.
+            out["backlog_drain_per_s"] = round((qb - qa) / window, 4)
+            ub, ua = b("fleet.slot_util"), a("fleet.slot_util")
+            if ub is not None and ua is not None:
+                out["util_before"] = round(float(ub), 4)
+                out["util_after"] = round(float(ua), 4)
+            effect = (qb - qa) / window if (qb or qa) else None
+        elif decision.action in (GROW_WORLD, SHRINK_WORLD, SEED_WORLD):
+            size_b = float(b("world.size", 0) or 0)
+            size_a = float(a("world.size", 0) or 0)
+            todo_b = b("data.todo")
+            todo_a = a("data.todo")
+            out["world_before"] = int(size_b)
+            out["world_after"] = int(size_a)
+            out["world_converged"] = (
+                int(size_a) == int(decision.target)
+            )
+            if todo_b is not None and todo_a is not None:
+                pb = float(todo_b) / max(size_b, 1.0)
+                pa = float(todo_a) / max(size_a, 1.0)
+                out["backlog_per_worker_before"] = round(pb, 2)
+                out["backlog_per_worker_after"] = round(pa, 2)
+                effect = pb - pa
+        elif decision.action == SET_CKPT_INTERVAL:
+            old = b("ckpt.interval_s")
+            new = float(decision.target)
+            mtbf = a("fault.mtbf_s", b("fault.mtbf_s"))
+            save_block = float(b("ckpt.save_block_s", 0.0) or 0.0)
+            if old and mtbf:
+                old, mtbf = float(old), float(mtbf)
+                # Young/Daly accounting, per hour of runtime: expected
+                # replay per failure is interval/2, failures arrive at
+                # 3600/MTBF per hour; the retune also changes the save
+                # overhead (3600/interval saves × blocking cost).
+                failures_per_h = 3600.0 / mtbf
+                avoided = (old - new) / 2.0 * failures_per_h
+                extra_saves = save_block * 3600.0 * (
+                    1.0 / max(new, 1e-9) - 1.0 / max(old, 1e-9)
+                )
+                out["avoided_replay_s_per_hour"] = round(avoided, 4)
+                out["extra_save_s_per_hour"] = round(extra_saves, 4)
+                effect = avoided - extra_saves
+                out["est_net_saved_s_per_hour"] = round(effect, 4)
+        if effect is None and out.get("goodput_delta") is not None:
+            effect = float(out["goodput_delta"])
+        if effect is not None:
+            out["effect"] = round(effect, 6)
+            eps = 1e-6
+            out["verdict"] = (
+                "improved" if effect > eps
+                else "regressed" if effect < -eps else "neutral"
+            )
+        else:
+            out["verdict"] = "neutral"
+        return out
 
     def _seed_from_brain(self, snap: SignalSnapshot):
         if self._brain is None:
@@ -218,6 +441,7 @@ class AutoScaler:
             ),
             signals=dict(snap.values),
             ts=snap.ts,
+            mono=snap.mono,
         ))
 
     # ---- lifecycle ---------------------------------------------------------
@@ -247,7 +471,16 @@ class AutoScaler:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        # A decision whose window hasn't elapsed still gets its outcome
+        # measured against the last snapshot (marked truncated): an
+        # annotation gap at shutdown would read as "effect unknown".
+        if self._pending_outcomes:
+            snap = self.bus.latest()
+            if snap is not None:
+                self._resolve_outcomes(snap, force=True)
         self._report_completion(success)
+        if self.recorder is not None:
+            self.recorder.close()
 
     def _report_completion(self, success: bool):
         if self._brain is None or self._completion_reported:
@@ -265,12 +498,21 @@ class AutoScaler:
 
     # ---- dashboard surface -------------------------------------------------
 
-    def api_state(self, last: int = 50) -> Dict[str, object]:
+    def api_state(self, last: int = 50, offset: int = 0,
+                  compact: bool = False) -> Dict[str, object]:
         """The ``/api/autoscaler`` payload: live signals, the recent
         ledger, and the dry-run diff (decisions the loop took vs
-        actuations it performed — in dry-run the gap IS the diff)."""
+        actuations it performed — in dry-run the gap IS the diff).
+
+        ``last``/``offset`` page backward through the ledger and
+        ``compact`` drops the per-decision triggering snapshots
+        (``signals_truncated``) — a 512-entry ledger over a large
+        world serializes to multi-MB otherwise."""
         snap = self.bus.latest()
-        decisions = self.ledger.entries(last=last)
+        decision_dicts = [
+            d.to_dict(include_signals=not compact)
+            for d in self.ledger.entries(last=last, offset=offset)
+        ]
         return {
             "enabled": True,
             "dry_run": self.dry_run,
@@ -280,9 +522,25 @@ class AutoScaler:
                 {"seq": snap.seq, "ts": snap.ts, "values": snap.values}
                 if snap is not None else None
             ),
-            "decisions": [d.to_dict() for d in decisions],
+            "decisions": decision_dicts,
             "decisions_total": self.ledger.decisions_total,
             "actuations_total": self.ledger.actuations_total,
+            "ledger_window": {
+                "last": last,
+                "offset": offset,
+                "returned": len(decision_dicts),
+                "compact": compact,
+            },
+            "outcomes": {
+                "attached": self.ledger.outcomes_total,
+                "missed": self.ledger.outcome_misses_total,
+                "pending": len(self._pending_outcomes),
+                "window_s": self.attribution_window_s,
+            },
+            "recording": (
+                self.recorder.stats()
+                if self.recorder is not None else None
+            ),
             "dry_run_diff": {
                 "decisions_total": self.ledger.decisions_total,
                 "actuations_total": self.ledger.actuations_total,

@@ -32,7 +32,7 @@ run's.
 
 import threading
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Deque, Dict, List, Optional
 
 from dlrover_tpu.autoscaler.signals import SignalSnapshot
@@ -67,6 +67,12 @@ class ScaleDecision:
     the loop did with it: ``"actuated"``, ``"dry_run"``, ``"advisory"``
     (no actuator bound — e.g. ckpt cadence on a master that only
     publishes the recommendation), or ``"error:<msg>"``.
+
+    ``mono`` mirrors the triggering snapshot's monotonic stamp (replay
+    ordering); ``realized`` is the outcome-attribution backfill the
+    loop writes once the decision's attribution window closes — the
+    measured effect (goodput delta, straggler-score drop, backlog
+    drain, avoided-replay estimate), not the intent.
     """
 
     action: str
@@ -76,17 +82,31 @@ class ScaleDecision:
     ts: float = 0.0
     seq: int = 0
     outcome: str = ""
+    mono: float = 0.0
+    realized: Optional[Dict[str, object]] = None
 
-    def to_dict(self) -> Dict[str, object]:
-        return {
+    def to_dict(self, include_signals: bool = True) -> Dict[str, object]:
+        """``include_signals=False`` is the dashboard's compact mode:
+        the triggering snapshot (potentially thousands of per-rank
+        values) is replaced by its key count, never copied."""
+        out = {
             "seq": self.seq,
             "ts": self.ts,
+            "mono": self.mono,
             "action": self.action,
             "target": self.target,
             "reason": self.reason,
             "outcome": self.outcome,
-            "signals": dict(self.signals),
         }
+        if include_signals:
+            out["signals"] = dict(self.signals)
+        else:
+            out["signals"] = {}
+            out["signals_truncated"] = True
+            out["signal_keys"] = len(self.signals)
+        if self.realized is not None:
+            out["realized"] = dict(self.realized)
+        return out
 
 
 class DecisionLedger:
@@ -98,6 +118,8 @@ class DecisionLedger:
         self._seq = 0
         self._total = 0
         self._actuated = 0
+        self._outcomes = 0
+        self._outcome_misses = 0
 
     def append(self, decision: ScaleDecision) -> ScaleDecision:
         with self._lock:
@@ -109,9 +131,31 @@ class DecisionLedger:
                 self._actuated += 1
         return decision
 
-    def entries(self, last: Optional[int] = None) -> List[ScaleDecision]:
+    def attach_outcome(self, seq: int, realized: Dict) -> bool:
+        """Backfill the realized effect onto the ledger entry with this
+        seq. An entry already evicted by the bound is a COUNTED no-op
+        (False), never a KeyError — a long attribution window on a
+        small ledger must not crash the loop."""
+        with self._lock:
+            for d in reversed(self._entries):
+                if d.seq == seq:
+                    d.realized = dict(realized)
+                    self._outcomes += 1
+                    return True
+                if d.seq < seq:
+                    break  # entries are seq-ascending; it's gone
+            self._outcome_misses += 1
+            return False
+
+    def entries(self, last: Optional[int] = None,
+                offset: int = 0) -> List[ScaleDecision]:
+        """The newest ``last`` entries (all when falsy), after skipping
+        the ``offset`` newest — the /api/autoscaler pagination window
+        (offset pages BACKWARD through history)."""
         with self._lock:
             items = list(self._entries)
+        if offset > 0:
+            items = items[:-offset] if offset < len(items) else []
         return items[-last:] if last else items
 
     @property
@@ -123,6 +167,16 @@ class DecisionLedger:
     def actuations_total(self) -> int:
         with self._lock:
             return self._actuated
+
+    @property
+    def outcomes_total(self) -> int:
+        with self._lock:
+            return self._outcomes
+
+    @property
+    def outcome_misses_total(self) -> int:
+        with self._lock:
+            return self._outcome_misses
 
 
 @dataclass
@@ -155,6 +209,17 @@ class PolicyConfig:
     fleet_confirm_ticks: int = 2
     fleet_cooldown_s: float = 10.0
 
+    def to_dict(self) -> Dict[str, object]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "PolicyConfig":
+        """Tolerant load for recordings: unknown keys (a newer writer's
+        fields) are dropped so an old reader can still replay."""
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in (data or {}).items()
+                      if k in known})
+
 
 class RulePolicy:
     """See module docstring. Stateful only in confirmation counters and
@@ -180,7 +245,7 @@ class RulePolicy:
         self._last_action_ts[action] = snap.ts
         out.append(ScaleDecision(
             action=action, target=target, reason=reason,
-            signals=dict(snap.values), ts=snap.ts,
+            signals=dict(snap.values), ts=snap.ts, mono=snap.mono,
         ))
 
     # ---- the rules ---------------------------------------------------------

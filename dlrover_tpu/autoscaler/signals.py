@@ -36,11 +36,18 @@ from dlrover_tpu.flash_ckpt.autotune import MtbfTracker
 class SignalSnapshot:
     """One sampled view of the job. ``values`` maps flat
     ``"<source>.<key>"`` names to scalars (or small lists/dicts for
-    e.g. straggler scores)."""
+    e.g. straggler scores).
+
+    ``ts`` is the wall clock (what the clockless policy rules consume
+    and what humans read in the ledger); ``mono`` is its monotonic
+    twin, stamped at the same instant — the recorder persists the pair
+    and the replay reader ORDERS by ``mono``, so an NTP step mid-run
+    cannot reorder a recording."""
 
     seq: int
     ts: float
     values: Dict[str, object] = field(default_factory=dict)
+    mono: float = 0.0
 
     def get(self, key: str, default=None):
         return self.values.get(key, default)
@@ -55,9 +62,17 @@ class SignalBus:
     """
 
     def __init__(self, clock: Callable[[], float] = time.time,
-                 history: int = 128):
+                 history: int = 128,
+                 mono_clock: Optional[Callable[[], float]] = None):
         self._lock = threading.Lock()
         self._clock = clock
+        # Every snapshot stamps a (wall, mono) PAIR. With the real wall
+        # clock the monotonic twin is time.monotonic; an injected fake
+        # clock drives both (tests advance one clock, both stamps move
+        # together — and replay ordering stays coherent).
+        if mono_clock is None:
+            mono_clock = time.monotonic if clock is time.time else clock
+        self._mono_clock = mono_clock
         self._sources: Dict[str, Callable[[], Dict[str, object]]] = {}
         self._history: Deque[SignalSnapshot] = deque(maxlen=max(history, 1))
         self._seq = 0
@@ -89,7 +104,10 @@ class SignalBus:
             except Exception as e:  # noqa: BLE001 — one eye shut, keep seeing
                 values[f"{name}.error"] = f"{type(e).__name__}: {e}"[:160]
                 logger.warning("signal source %r failed: %s", name, e)
-        snap = SignalSnapshot(seq=seq, ts=self._clock(), values=values)
+        snap = SignalSnapshot(
+            seq=seq, ts=self._clock(), mono=self._mono_clock(),
+            values=values,
+        )
         with self._lock:
             self._history.append(snap)
         return snap
@@ -115,9 +133,15 @@ class FaultHistory:
     soak harness; exposes failures_total, the age of the newest failure
     and — once ``min_failures`` arrivals are in the window — the
     observed mean time between failures (:class:`MtbfTracker`).
+
+    The default clock is MONOTONIC (audit satellite): every consumer
+    here is a time *difference* (inter-arrival gaps, failure age), and
+    a wall-clock step between two failures would corrupt the observed
+    MTBF the ckpt-cadence rule retunes from. Injected fake clocks
+    behave as before.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.time,
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
                  window: int = 32, min_failures: int = 2):
         self._lock = threading.Lock()
         self._clock = clock

@@ -121,6 +121,15 @@ def build_master_parser() -> argparse.ArgumentParser:
         "--legal_worker_counts when given",
     )
     parser.add_argument(
+        "--autoscale_record",
+        type=str,
+        default="",
+        help="durably record the autoscaler's signal/decision/outcome "
+        "stream to this JSONL path (docs/DESIGN.md §34) for offline "
+        "what-if policy replay (tools/whatif.py); also armed by "
+        "DLROVER_TPU_AUTOSCALE_RECORD",
+    )
+    parser.add_argument(
         "--autoscale_ckpt_interval_s",
         type=float,
         default=60.0,

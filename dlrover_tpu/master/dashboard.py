@@ -11,6 +11,7 @@ rendezvous state, dataset progress, and training perf.
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -202,9 +203,12 @@ class DashboardServer:
                 # Live per-rank step-time skew (the autoscaler's and
                 # SRE's "which rank is slow RIGHT NOW" view).
                 "/api/stragglers": lambda: dashboard._stragglers(),
-                # The §30 resource brain: live signal snapshot, recent
-                # decision ledger, dry-run diff.
-                "/api/autoscaler": lambda: dashboard._autoscaler_state(),
+                # §34 per-cause goodput attribution: where the
+                # non-train wall time went (train + ckpt/rescale/
+                # straggler/hang/shed + unattributed residual), the
+                # averaging basis, and the serving-side useful-token
+                # fraction merged into one view.
+                "/api/goodput": lambda: dashboard._goodput(),
                 # The §32 saturation plane: overload governor state,
                 # per-verb RPC telemetry, bounded-buffer occupancy.
                 "/api/control_plane": (
@@ -228,6 +232,16 @@ class DashboardServer:
                         self._send_unavailable(e)
                         return
                     self._send(200, text, "text/plain; version=0.0.4")
+                elif self.path.startswith("/api/autoscaler"):
+                    # The §30/§34 resource brain: live signal snapshot,
+                    # the decision ledger (with realized outcomes), and
+                    # the dry-run diff. Query params page the ledger
+                    # (?last=N&offset=M) and ?signals=compact drops the
+                    # per-decision triggering snapshots — a full ledger
+                    # over a large world is a multi-MB response.
+                    self._send_json(
+                        lambda: dashboard._autoscaler_state(self.path)
+                    )
                 elif self.path.startswith("/api/traces"):
                     self._send_json(
                         lambda: dashboard._traces(self.path)
@@ -297,7 +311,60 @@ class DashboardServer:
         if callable(breakdown):
             perf["phase_breakdown"] = breakdown()
             perf["phase_fractions"] = breakdown(as_fractions=True)
+        # Averaging mode + node count (was only a code comment): a
+        # 1-node 0.9 and a 64-node 0.9 are different claims.
+        basis = getattr(self._perf_monitor, "goodput_basis", None)
+        if callable(basis):
+            perf["goodput_basis"] = basis()
         return perf
+
+    def _goodput(self):
+        attribution = getattr(
+            self._perf_monitor, "goodput_attribution", None
+        )
+        basis = getattr(self._perf_monitor, "goodput_basis", None)
+        out = {
+            "training": attribution() if callable(attribution) else None,
+            "goodput_basis": basis() if callable(basis) else None,
+            "serving": self._serving_useful_tokens(),
+        }
+        return out
+
+    @staticmethod
+    def _serving_useful_tokens():
+        """Serving-side useful-token fraction from the registry: tokens
+        computed minus tokens thrown away by progress resets
+        (step-error requeues, pool preemptions). Families absent (no
+        engine in this process) read as disabled."""
+        from dlrover_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        tokens = reg.get("serving_tokens_total")
+        if tokens is None:
+            return {"enabled": False}
+        by_kind = {
+            labels.get("kind", ""): value
+            for _, labels, value in tokens.samples()
+        }
+        total = sum(by_kind.values())
+        wasted_fam = reg.get("serving_tokens_wasted_total")
+        wasted = {}
+        if wasted_fam is not None:
+            wasted = {
+                labels.get("kind", ""): value
+                for _, labels, value in wasted_fam.samples()
+            }
+        wasted_total = sum(wasted.values())
+        return {
+            "enabled": True,
+            "tokens_total": total,
+            "tokens_by_kind": by_kind,
+            "tokens_wasted_total": wasted_total,
+            "tokens_wasted_by_kind": wasted,
+            "useful_token_frac": round(
+                (total - wasted_total) / total, 6
+            ) if total > 0 else None,
+        }
 
     def _phases(self):
         records = getattr(self._perf_monitor, "phase_records", None)
@@ -311,11 +378,29 @@ class DashboardServer:
             return report()
         return {"ranks": {}, "stragglers": [], "median_step_time_s": 0.0}
 
-    def _autoscaler_state(self):
+    def _autoscaler_state(self, path: str = "/api/autoscaler"):
         if self._autoscaler is None:
             return {"enabled": False}
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(path).query
+        )
+
+        def q_int(name, default):
+            try:
+                return max(int(query[name][0]), 0)
+            except (KeyError, ValueError, IndexError):
+                return default
+
+        compact = (
+            query.get("signals", [""])[0] == "compact"
+            or query.get("compact", ["0"])[0] in ("1", "true")
+        )
         try:
-            return self._autoscaler.api_state()
+            return self._autoscaler.api_state(
+                last=q_int("last", 50),
+                offset=q_int("offset", 0),
+                compact=compact,
+            )
         except Exception as e:  # noqa: BLE001 — dashboard never 500s
             return {"enabled": True, "error": f"{type(e).__name__}: {e}"}
 

@@ -90,6 +90,7 @@ class DistributedJobMaster:
         autoscale_interval_s: float = 5.0,
         autoscale_max_world: int = 0,
         autoscale_ckpt_interval_s: float = 60.0,
+        autoscale_record: str = "",
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -244,6 +245,7 @@ class DistributedJobMaster:
                 max_world=autoscale_max_world,
                 legal_worker_counts=legal_worker_counts,
                 ckpt_interval_s=autoscale_ckpt_interval_s,
+                record_path=autoscale_record,
             )
         self.dashboard = None
         if dashboard_port >= 0:
@@ -298,7 +300,8 @@ class DistributedJobMaster:
     def _build_autoscaler(self, scaler, dry_run: bool, interval_s: float,
                           brain_addr: str, max_world: int = 0,
                           legal_worker_counts=None,
-                          ckpt_interval_s: float = 60.0):
+                          ckpt_interval_s: float = 60.0,
+                          record_path: str = ""):
         from dlrover_tpu.autoscaler import (
             AutoScaler,
             BrainPrior,
@@ -312,6 +315,7 @@ class DistributedJobMaster:
             SET_CKPT_INTERVAL,
             SHRINK_WORLD,
             SignalBus,
+            SignalRecorder,
             control_plane_source,
             data_source,
             fault_source,
@@ -433,6 +437,12 @@ class DistributedJobMaster:
                 if brain_addr else None
             ),
             job_name=self.job_name,
+            # §34: durable signal/decision/outcome recording for
+            # offline what-if replay; env arming still applies when
+            # the flag is unset.
+            recorder=(
+                SignalRecorder(record_path) if record_path else None
+            ),
         )
 
     def _build_diagnosis_master(self, pre_check: bool):
@@ -553,6 +563,7 @@ class DistributedJobMaster:
             autoscale_ckpt_interval_s=getattr(
                 args, "autoscale_ckpt_interval_s", 60.0
             ),
+            autoscale_record=getattr(args, "autoscale_record", ""),
         )
 
     # ---- lifecycle ---------------------------------------------------------

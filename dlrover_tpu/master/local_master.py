@@ -35,6 +35,7 @@ class LocalJobMaster:
         autoscale_loop: bool = False,
         autoscale_dry_run: bool = False,
         autoscale_interval_s: float = 5.0,
+        autoscale_record: str = "",
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -105,6 +106,7 @@ class LocalJobMaster:
                 FaultHistory,
                 SET_CKPT_INTERVAL,
                 SignalBus,
+                SignalRecorder,
                 control_plane_source,
                 data_source,
                 fault_source,
@@ -148,6 +150,13 @@ class LocalJobMaster:
                 interval_s=autoscale_interval_s,
                 dry_run=autoscale_dry_run,
                 job_name=job_name,
+                # §34: durable signal/decision/outcome recording for
+                # offline what-if replay (env arming still applies when
+                # the flag is unset — AutoScaler falls back to it).
+                recorder=(
+                    SignalRecorder(autoscale_record)
+                    if autoscale_record else None
+                ),
             )
 
     def _build_diagnosis_master(self):

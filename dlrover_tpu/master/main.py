@@ -40,6 +40,7 @@ def run(args) -> int:
             autoscale_interval_s=getattr(
                 args, "autoscale_interval_s", 5.0
             ),
+            autoscale_record=getattr(args, "autoscale_record", ""),
         )
     else:
         try:

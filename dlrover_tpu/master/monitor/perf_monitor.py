@@ -17,6 +17,19 @@ from dlrover_tpu.observability.registry import default_registry
 
 
 class PerfMonitor:
+    # §34 lost-time cause taxonomy: every non-train wall second is
+    # attributed to the decision/fault that cost it, or lands in the
+    # single residual bucket "unattributed". The /api/goodput view and
+    # the soak's ≥90%-attribution invariant read these names verbatim.
+    CAUSES = ("ckpt", "rescale", "straggler", "hang", "shed")
+    UNATTRIBUTED = "unattributed"
+    # Phases whose cause is implied when the reporter passes none.
+    _PHASE_CAUSE = {
+        GoodputPhase.CKPT: "ckpt",
+        GoodputPhase.RESTART: "rescale",
+        GoodputPhase.RENDEZVOUS: "rescale",
+    }
+
     def __init__(self, speed_window: int = 30, max_phase_records: int = 4096):
         self._lock = threading.Lock()
         self._start_time = time.time()
@@ -27,6 +40,10 @@ class PerfMonitor:
         # phase -> node_id -> seconds; goodput is averaged per node so a
         # multi-node job cannot saturate the metric at 1.0.
         self._phase_secs: Dict[str, Dict[int, float]] = defaultdict(
+            lambda: defaultdict(float)
+        )
+        # cause -> node_id -> lost seconds (non-train intervals only).
+        self._cause_secs: Dict[str, Dict[int, float]] = defaultdict(
             lambda: defaultdict(float)
         )
         # Raw (node, phase, start, end) intervals, bounded: the timeline
@@ -66,6 +83,11 @@ class PerfMonitor:
             "dlrover_straggler_score",
             "per-rank step-time skew vs the fleet median (1.0 = median)",
             labelnames=("rank",),
+        )
+        self._lost_secs_counter = registry.counter(
+            "dlrover_goodput_lost_seconds_total",
+            "non-train wall seconds by attributed cause (§34 taxonomy)",
+            labelnames=("cause",),
         )
 
     # ---- step speed --------------------------------------------------------
@@ -247,23 +269,42 @@ class PerfMonitor:
 
     # ---- goodput ledger ----------------------------------------------------
 
-    def collect_phase(self, node_id: int, phase: str, start: float, end: float):
+    def collect_phase(self, node_id: int, phase: str, start: float,
+                      end: float, cause: Optional[str] = None):
+        """Attribute one wall interval. Non-train intervals also carry
+        a lost-time ``cause`` from the §34 taxonomy (:attr:`CAUSES`):
+        explicit when the reporter knows who to blame (the autoscaler's
+        eviction pause is ``straggler``, an overload shed is ``shed``),
+        implied from the phase otherwise (ckpt→ckpt, restart→rescale),
+        and ``unattributed`` as the only residual bucket."""
         if end <= start:
             return
+        record = {
+            "node_id": node_id,
+            "phase": phase,
+            "start": start,
+            "end": end,
+        }
+        if phase == GoodputPhase.TRAIN:
+            cause = None
+        else:
+            cause = cause or self._PHASE_CAUSE.get(
+                phase, self.UNATTRIBUTED
+            )
+            if cause not in self.CAUSES:
+                cause = self.UNATTRIBUTED
+            record["cause"] = cause
         with self._lock:
             self._phase_secs[phase][node_id] += end - start
+            if cause is not None:
+                self._cause_secs[cause][node_id] += end - start
             if len(self._phase_records) == self._phase_records.maxlen:
                 self._phase_records_dropped += 1
-            self._phase_records.append(
-                {
-                    "node_id": node_id,
-                    "phase": phase,
-                    "start": start,
-                    "end": end,
-                }
-            )
+            self._phase_records.append(record)
             self._max_phase_end = max(self._max_phase_end, end)
         self._phase_secs_counter.inc(end - start, name=phase)
+        if cause is not None:
+            self._lost_secs_counter.inc(end - start, cause=cause)
 
     def goodput(self) -> float:
         """Fraction of wall time spent in productive training, averaged
@@ -275,6 +316,83 @@ class PerfMonitor:
                 return 0.0
             ratios = [min(t / wall, 1.0) for t in per_node.values()]
             return sum(ratios) / len(ratios)
+
+    def goodput_basis(self) -> Dict:
+        """How :meth:`goodput` is computed — previously only a code
+        comment. Consumers (dashboards, the autoscaler, SREs reading
+        /api/perf) need the averaging mode and node count to interpret
+        the number: a 1-node 0.9 and a 64-node 0.9 are different
+        claims."""
+        with self._lock:
+            per_node = self._phase_secs.get(GoodputPhase.TRAIN, {})
+            return {
+                "averaging": "per_node_train_fraction_mean",
+                "nodes_reporting": len(per_node),
+                "wall_s": round(
+                    max(self._max_phase_end - self._init_time, 0.0), 6
+                ),
+                "wall_origin": "init_time_to_max_phase_end",
+                "records_dropped": self._phase_records_dropped,
+            }
+
+    def goodput_attribution(self) -> Dict:
+        """Per-cause accounting of the non-train wall time (§34): for
+        the same node set and wall basis as :meth:`goodput`, how many
+        lost seconds each cause explains, and what fraction of the
+        lost time is attributed at all. ``unattributed`` is the only
+        residual bucket — it covers both intervals reported without a
+        cause and wall time nobody reported a phase for."""
+        with self._lock:
+            wall = max(self._max_phase_end - self._init_time, 1e-9)
+            train_nodes = self._phase_secs.get(GoodputPhase.TRAIN, {})
+            nodes = set(train_nodes)
+            for per_node in self._cause_secs.values():
+                nodes.update(per_node)
+            if not nodes:
+                return {
+                    "wall_s": 0.0, "train_frac": 0.0, "lost_frac": 0.0,
+                    "causes": {}, "unattributed_frac": 0.0,
+                    "attributed_frac": 0.0, "nodes": 0,
+                }
+            n = len(nodes)
+            train_frac = sum(
+                min(train_nodes.get(node, 0.0) / wall, 1.0)
+                for node in nodes
+            ) / n
+            causes: Dict[str, Dict[str, float]] = {}
+            explained = 0.0
+            for cause in (*self.CAUSES, self.UNATTRIBUTED):
+                per_node = self._cause_secs.get(cause, {})
+                secs = sum(per_node.get(node, 0.0) for node in nodes) / n
+                frac = min(secs / wall, 1.0)
+                causes[cause] = {
+                    "seconds": round(secs, 6),
+                    "frac": round(frac, 6),
+                }
+                if cause != self.UNATTRIBUTED:
+                    explained += frac
+        lost_frac = max(1.0 - train_frac, 0.0)
+        explained = min(explained, lost_frac)
+        # The residual bucket covers BOTH cause-less reports and
+        # never-reported wall time; rewrite seconds and frac together
+        # so the two fields of the dict cannot disagree (the reported
+        # cause-less seconds alone would understate the residual).
+        residual_frac = max(lost_frac - explained, 0.0)
+        causes[self.UNATTRIBUTED] = {
+            "seconds": round(residual_frac * wall, 6),
+            "frac": round(residual_frac, 6),
+        }
+        return {
+            "wall_s": round(wall, 6),
+            "train_frac": round(train_frac, 6),
+            "lost_frac": round(lost_frac, 6),
+            "causes": causes,
+            "unattributed_frac": causes[self.UNATTRIBUTED]["frac"],
+            "attributed_frac": round(
+                explained / lost_frac if lost_frac > 1e-9 else 1.0, 6
+            ),
+            "nodes": n,
+        }
 
     def phase_breakdown(self, as_fractions: bool = False) -> Dict[str, float]:
         with self._lock:
@@ -323,6 +441,7 @@ class PerfMonitor:
             self._last_step_report = None
             self._speed_records.clear()
             self._phase_secs.clear()
+            self._cause_secs.clear()
             self._phase_records.clear()
             self._phase_records_dropped = 0
             self._init_time = time.time()

@@ -290,11 +290,19 @@ def phases_to_trace(phases: Dict) -> List[Dict]:
     init_time = float(phases.get("init_time", 0.0))
     out: List[Dict] = [_meta(JOB_PID, "job goodput")]
     train_per_node: Dict[int, float] = {}
+    # cause -> node -> seconds: the lane renders the per-node MEAN so
+    # it agrees with goodput_attribution()'s averaging basis (a 0.4s
+    # lockstep pause reported by 4 nodes is 0.4s of wall, not 1.6s).
+    lost_by_cause: Dict[str, Dict[int, float]] = {}
     for rec in records:
         start = float(rec.get("start", 0.0))
         end = float(rec.get("end", 0.0))
         node = int(rec.get("node_id", 0))
         phase = str(rec.get("phase", ""))
+        cause = rec.get("cause")
+        args: Dict = {"node_id": node}
+        if cause:
+            args["cause"] = cause
         out.append(
             {
                 "name": phase,
@@ -303,9 +311,27 @@ def phases_to_trace(phases: Dict) -> List[Dict]:
                 "dur": max(end - start, 0.0) * 1e6,
                 "pid": JOB_PID,
                 "tid": node,
-                "args": {"node_id": node},
+                "args": args,
             }
         )
+        if cause:
+            # §34 lost-time lane: cumulative per-node-mean seconds per
+            # cause, a counter track beside the goodput one — the
+            # timeline shows WHERE the lost time went as it accrues.
+            per_node = lost_by_cause.setdefault(cause, {})
+            per_node[node] = per_node.get(node, 0.0) + (end - start)
+            out.append(
+                {
+                    "name": "lost_by_cause",
+                    "ph": "C",
+                    "ts": end * 1e6,
+                    "pid": JOB_PID,
+                    "args": {
+                        c: round(sum(nodes.values()) / len(nodes), 6)
+                        for c, nodes in sorted(lost_by_cause.items())
+                    },
+                }
+            )
         if phase == GoodputPhase.TRAIN:
             train_per_node[node] = (
                 train_per_node.get(node, 0.0) + (end - start)
@@ -420,6 +446,25 @@ def merge_job_timeline(
         result["metadata"]["reconstructed_goodput"] = round(
             reconstruct_goodput(phases), 6
         )
+        # Per-node MEAN per cause — the same averaging basis as
+        # goodput_attribution(), so the two §34 surfaces agree.
+        lost: Dict[str, Dict[int, float]] = {}
+        for rec in phases.get("records", []):
+            cause = rec.get("cause")
+            if not cause:
+                continue
+            dur = float(rec.get("end", 0.0)) - float(
+                rec.get("start", 0.0)
+            )
+            if dur > 0:
+                per_node = lost.setdefault(cause, {})
+                node = int(rec.get("node_id", 0))
+                per_node[node] = per_node.get(node, 0.0) + dur
+        if lost:
+            result["metadata"]["lost_seconds_by_cause"] = {
+                c: round(sum(nodes.values()) / len(nodes), 6)
+                for c, nodes in sorted(lost.items())
+            }
     return result
 
 

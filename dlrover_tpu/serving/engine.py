@@ -431,12 +431,22 @@ class ServingEngine:
         restarts — admitted work is never silently lost, and a
         persistent error cannot livelock the serve loop. Failed
         requests surface through ``finished`` with ``failed=True``."""
+        # Progress about to be reset IS the wasted work: prompt rows
+        # already prefilled and tokens already decoded replay from
+        # scratch (§34 useful-token accounting).
+        active = self.scheduler.active()
+        wasted_prefill = sum(r.prefill_pos for r in active)
+        wasted_decode = sum(len(r.tokens) for r in active)
         requeued = self.scheduler.requeue_active()
         self._reset_pool()
         self._lengths[:] = 0
         self._tokens[:] = 0
         self._temps[:] = 0.0
         self.metrics.step_errors.inc()
+        if wasted_prefill:
+            self.metrics.tokens_wasted.inc(wasted_prefill, kind="prefill")
+        if wasted_decode:
+            self.metrics.tokens_wasted.inc(wasted_decode, kind="decode")
         failed = 0
         for req in requeued:
             if req.requeues > self.max_requeues:

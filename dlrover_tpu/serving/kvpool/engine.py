@@ -582,6 +582,15 @@ class PagedServingEngine(ServingEngine):
 
     def _preempt(self, victim: Request) -> None:
         slot = victim.slot
+        # Reset-to-zero progress is wasted compute (§34 accounting).
+        if victim.prefill_pos:
+            self.metrics.tokens_wasted.inc(
+                victim.prefill_pos, kind="prefill"
+            )
+        if victim.tokens:
+            self.metrics.tokens_wasted.inc(
+                len(victim.tokens), kind="decode"
+            )
         self.scheduler.preempt(victim)
         self._release_slot(victim, slot)
         self._lengths[slot] = 0

@@ -51,6 +51,13 @@ class ServingMetrics:
             "tokens processed, prefill (prompt) vs decode (generated)",
             labelnames=("kind",),
         )
+        self.tokens_wasted = reg.counter(
+            "serving_tokens_wasted_total",
+            "computed tokens thrown away by progress resets (step-error "
+            "requeues, pool preemptions) — the serving side of the §34 "
+            "useful-token fraction in /api/goodput",
+            labelnames=("kind",),
+        )
         self.iterations = reg.counter(
             "serving_iterations_total", "engine scheduler iterations"
         )

@@ -34,24 +34,44 @@ Invariants (docs/DESIGN.md §30):
    explained outcome (no unexplained actions);
 4. dry-run mode emits the same leading decision with ZERO actuations;
 5. both runs drain the dataset exactly once (TaskManager accounting).
+
+The §34 record→replay→perturb leg extends the episode: the autoscaled
+run's signal stream is durably recorded (SignalRecorder), replayed
+offline through the SAME PolicyConfig (must reproduce the live ledger
+decision-for-decision — the replay identity invariant), and through a
+PERTURBED config (must produce a differing, scored counterfactual
+ledger). Two more invariants ride along: every actuated decision
+carries a realized-outcome annotation, and the per-cause goodput
+attribution explains ≥90% of the non-train wall time.
 """
 
+import os
 import random
+import shutil
+import tempfile
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 from dlrover_tpu.autoscaler import (
     AutoScaler,
     CadenceController,
+    CostModel,
     FaultHistory,
     PolicyConfig,
+    ReplayMismatch,
     RulePolicy,
     SignalBus,
+    SignalRecorder,
     TrainWorldActuator,
+    assert_replay_identity,
     data_source,
+    diff_ledgers,
     fault_source,
+    load_recording,
     perf_source,
+    replay_recording,
+    score_ledger,
 )
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import GoodputPhase, NodeType
@@ -231,9 +251,12 @@ def _policy_config(cfg: AutoscaleSoakConfig) -> PolicyConfig:
 
 
 def run_sim_job(mode: str, seed: int, episode: int,
-                cfg: Optional[AutoscaleSoakConfig] = None) -> Dict:
+                cfg: Optional[AutoscaleSoakConfig] = None,
+                record_path: Optional[str] = None) -> Dict:
     """One run of the sim job under (seed, episode)'s fault schedule.
-    ``mode``: "static" | "dry_run" | "auto". Returns the run report."""
+    ``mode``: "static" | "dry_run" | "auto". ``record_path`` arms a
+    SignalRecorder on the autoscaler (the §34 replay leg's input).
+    Returns the run report."""
     assert mode in ("static", "dry_run", "auto"), mode
     cfg = cfg or AutoscaleSoakConfig()
     plan = build_autoscale_plan(seed, episode, cfg)
@@ -282,6 +305,10 @@ def run_sim_job(mode: str, seed: int, episode: int,
             .add_source("world", world_actuator.as_source())
             .add_source("ckpt", cadence.as_source())
         )
+        recorder = (
+            SignalRecorder(record_path)
+            if record_path else None
+        )
         autoscaler = AutoScaler(
             bus,
             policy=RulePolicy(_policy_config(cfg)),
@@ -293,6 +320,10 @@ def run_sim_job(mode: str, seed: int, episode: int,
             },
             interval_s=cfg.decision_interval_s,
             dry_run=(mode == "dry_run"),
+            recorder=recorder,
+            # Realized effects must show within a few decision windows:
+            # the eviction's score drop, the fleet grow's backlog drain.
+            attribution_window_s=4.0 * cfg.decision_interval_s,
         )
 
     # ---- the lockstep sim loop --------------------------------------------
@@ -345,11 +376,23 @@ def run_sim_job(mode: str, seed: int, episode: int,
                 except FaultInjected:
                     rank_fault[node.id] = time.time() - f0
                     crashed.append(node)
+            t_fault_end = time.time()
             if (onset_wall is None
                     and rank_fault.get(straggler_node, 0.0)
                     > cfg.base_step_s):
                 onset_wall = time.time()
                 onset_tick = ticks
+            # §34 attribution: the fault section IS the straggler's
+            # stall in this lockstep sim (every rank waits out the
+            # delayed one); measured intervals, so sleep overshoot on a
+            # loaded box stays attributed too.
+            if t_fault_end - t_step > 1e-4 and not crashed:
+                for node in stepping:
+                    perf.collect_phase(
+                        node.rank_index, "stall", t_step, t_fault_end,
+                        cause="straggler",
+                    )
+            t_compute = time.time()
             time.sleep(cfg.base_step_s)  # the world's lockstep compute
             stall = max(rank_fault.values()) if rank_fault else 0.0
             stall_s += stall
@@ -375,6 +418,14 @@ def run_sim_job(mode: str, seed: int, episode: int,
                 replay = (step - last_save_step) * cfg.base_step_s
                 time.sleep(replay)
                 replay_s += replay
+                # The dead step + restart + replay is all rescale
+                # machinery time, for every lockstep participant.
+                t_recovered = time.time()
+                for node in stepping:
+                    perf.collect_phase(
+                        node.rank_index, GoodputPhase.RESTART,
+                        t_step, t_recovered, cause="rescale",
+                    )
                 continue
             now = time.time()
             for node in stepping:
@@ -388,7 +439,7 @@ def run_sim_job(mode: str, seed: int, episode: int,
                 )
                 perf.collect_phase(
                     node.rank_index, GoodputPhase.TRAIN,
-                    t_step, t_step + cfg.base_step_s,
+                    t_compute, now,
                 )
             productive_s += cfg.base_step_s
             step += 1
@@ -397,20 +448,34 @@ def run_sim_job(mode: str, seed: int, episode: int,
                 time.sleep(cfg.save_block_s)
                 save_s += cfg.save_block_s
                 saves += 1
-                last_save_wall = time.time()
+                t_saved = time.time()
+                for node in stepping:
+                    perf.collect_phase(
+                        node.rank_index, GoodputPhase.CKPT,
+                        now, t_saved, cause="ckpt",
+                    )
+                last_save_wall = t_saved
                 last_save_step = step
             if (autoscaler is not None
                     and now - last_tick_wall >= cfg.decision_interval_s):
                 before_ids = {n.id for n in scaler.alive_nodes()}
+                t_tick = time.time()
                 autoscaler.tick()
                 ticks += 1
                 last_tick_wall = time.time()
                 after_ids = {n.id for n in scaler.alive_nodes()}
                 if after_ids != before_ids:
                     # An actuated membership change (the eviction):
-                    # the surviving world pays one rescale pause.
+                    # the surviving world pays one rescale pause —
+                    # attributed to the straggler that forced it.
                     time.sleep(cfg.restart_s)
                     restart_pause_s += cfg.restart_s
+                    t_evicted = time.time()
+                    for node in stepping:
+                        perf.collect_phase(
+                            node.rank_index, GoodputPhase.RESTART,
+                            t_tick, t_evicted, cause="straggler",
+                        )
                     if (straggler_node not in after_ids
                             and mitigated_wall is None):
                         mitigated_wall = time.time()
@@ -418,6 +483,11 @@ def run_sim_job(mode: str, seed: int, episode: int,
     finally:
         disarm()
         task_manager.stop()
+        if autoscaler is not None:
+            # Resolves still-open attribution windows against the last
+            # snapshot (truncated) and closes the recorder — the ledger
+            # read below must carry every realized outcome.
+            autoscaler.stop()
     wall = time.time() - t0
     # MEASURED shard accounting (shard_size=1: shards == records) —
     # the exactly-once invariant reads this, not the config constant.
@@ -460,12 +530,19 @@ def run_sim_job(mode: str, seed: int, episode: int,
             mitigated_wall - onset_wall, 3
         )
         report["mitigate_windows"] = mitigated_tick - (onset_tick or 0)
+    report["goodput_attribution"] = perf.goodput_attribution()
     if autoscaler is not None:
         report["decisions"] = [
             d.to_dict() for d in autoscaler.ledger.entries()
         ]
         report["decisions_total"] = autoscaler.ledger.decisions_total
         report["actuations_total"] = autoscaler.ledger.actuations_total
+        report["outcomes_attached"] = autoscaler.ledger.outcomes_total
+        report["outcome_misses"] = (
+            autoscaler.ledger.outcome_misses_total
+        )
+        if record_path:
+            report["record_path"] = record_path
     if failure:
         raise SoakInvariantError(failure)
     return report
@@ -528,6 +605,28 @@ def _check_invariants(static: Dict, auto: Dict,
             "autoscaled run recorded non-actuated decisions: "
             f"{[d['outcome'] for d in auto['decisions']]}"
         )
+    # §34 outcome coverage: every actuated decision in the autoscaled
+    # run carries a realized-outcome annotation (its attribution window
+    # resolved in-run, or force-resolved, truncated, at stop).
+    unannotated = [
+        d["seq"] for d in auto["decisions"]
+        if d["outcome"] == "actuated" and "realized" not in d
+    ]
+    if unannotated:
+        raise SoakInvariantError(
+            f"actuated decisions without realized outcomes: "
+            f"{unannotated}"
+        )
+    # §34 attribution coverage: ≥90% of the non-train wall time is
+    # explained by a taxonomy cause; unattributed is the only residual.
+    attribution = auto.get("goodput_attribution") or {}
+    attributed = attribution.get("attributed_frac", 0.0)
+    if attributed < 0.9:
+        raise SoakInvariantError(
+            f"goodput attribution too coarse: {attributed:.3f} of "
+            f"non-train wall attributed (< 0.9): "
+            f"{attribution.get('causes')}"
+        )
     # Dry-run contract: same brain, zero hands — a populated ledger
     # whose leading decision matches the live run's, and NO actuations.
     if dry is None:
@@ -561,15 +660,102 @@ def _check_invariants(static: Dict, auto: Dict,
         )
 
 
+def perturbed_config(cfg: AutoscaleSoakConfig) -> PolicyConfig:
+    """A deliberately passive candidate for the perturb leg: eviction
+    needs an unreachable confirmation streak and the fleet band never
+    triggers — given the same stream it must decide DIFFERENTLY from
+    the live policy (which provably evicted and grew)."""
+    return replace(
+        _policy_config(cfg),
+        straggler_confirm_ticks=10_000,
+        fleet_util_grow=1.01,       # util saturates at 1.0: never grows
+        fleet_util_shrink=-1.0,     # and never shrinks
+        ckpt_retune_frac=10.0,      # dead band swallows every retune
+    )
+
+
+def run_whatif_leg(auto: Dict, cfg: AutoscaleSoakConfig) -> Dict:
+    """The §34 record→replay→perturb leg over the autoscaled run's
+    recording. Asserts:
+
+    - **identity**: the recorded policy replayed over the recorded
+      snapshots reproduces the live decision ledger exactly;
+    - **perturbation**: a different PolicyConfig produces a DIFFERENT
+      counterfactual ledger, and both score under the goodput model
+      (calibrated from this episode's measured actuation costs).
+    """
+    record_path = auto.get("record_path")
+    if not record_path or not os.path.exists(record_path):
+        raise SoakInvariantError("autoscaled run produced no recording")
+    recording = load_recording(record_path)
+    if not recording.snapshots:
+        raise SoakInvariantError("recording carries no snapshots")
+    if recording.corrupt_lines:
+        raise SoakInvariantError(
+            f"recording has {recording.corrupt_lines} corrupt lines "
+            f"in a run that was never killed"
+        )
+    try:
+        identity = assert_replay_identity(recording)
+    except ReplayMismatch as e:
+        raise SoakInvariantError(f"replay identity violated: {e}")
+    t0 = time.monotonic()
+    perturbed = replay_recording(recording, perturbed_config(cfg))
+    replay_elapsed = max(time.monotonic() - t0, 1e-9)
+    diff = diff_ledgers(recording.decisions, perturbed)
+    if diff["identical"]:
+        raise SoakInvariantError(
+            "perturbed policy replayed IDENTICALLY to the live one — "
+            "the counterfactual engine is not counterfactual"
+        )
+    cost = CostModel(
+        rescale_to_first_step_s=cfg.restart_s,
+        evict_pause_s=cfg.restart_s,
+        save_block_s=cfg.save_block_s,
+    )
+    recorded_score = score_ledger(
+        recording.snapshots, recording.decisions, cost
+    )
+    perturbed_score = score_ledger(
+        recording.snapshots, perturbed, cost
+    )
+    for name, score in (("recorded", recorded_score),
+                        ("perturbed", perturbed_score)):
+        frac = score.get("est_goodput_frac")
+        if frac is None or not (0.0 <= frac <= 1.0):
+            raise SoakInvariantError(
+                f"{name} counterfactual ledger not scored: {score}"
+            )
+    return {
+        "whatif_identity_ok": True,
+        "whatif_snapshots": len(recording.snapshots),
+        "whatif_replay_snapshots_per_s": round(
+            len(recording.snapshots) / replay_elapsed, 1
+        ),
+        "whatif_recorded_decisions": identity["recorded_total"],
+        "whatif_perturbed_decisions": diff["replayed_total"],
+        "whatif_first_divergence": diff["first_divergence"],
+        "whatif_recorded_est_goodput": recorded_score[
+            "est_goodput_frac"
+        ],
+        "whatif_perturbed_est_goodput": perturbed_score[
+            "est_goodput_frac"
+        ],
+    }
+
+
 def run_autoscale_episode(
     seed: int,
     episode: int = 5,
     cfg: Optional[AutoscaleSoakConfig] = None,
     include_dry_run: bool = True,
+    record_dir: Optional[str] = None,
 ) -> Dict:
     """The full A/B(/C): static, dry-run, autoscaled under one seeded
-    schedule; asserts the §30 invariants; returns a soak-shaped report
-    with the autoscale extras the bench keeps."""
+    schedule; asserts the §30 invariants; then the §34 leg: record the
+    autoscaled run, replay it (identity), perturb it (counterfactual).
+    Returns a soak-shaped report with the autoscale extras the bench
+    keeps."""
     cfg = cfg or AutoscaleSoakConfig()
     plan = build_autoscale_plan(seed, episode, cfg)
     logger.info(
@@ -583,8 +769,22 @@ def run_autoscale_episode(
         run_sim_job("dry_run", seed, episode, cfg)
         if include_dry_run else None
     )
-    auto = run_sim_job("auto", seed, episode, cfg)
-    _check_invariants(static, auto, plan, cfg, dry=dry)
+    owned_record_dir = record_dir is None
+    if owned_record_dir:
+        record_dir = tempfile.mkdtemp(prefix="autoscale-rec-")
+    record_path = os.path.join(
+        record_dir, f"signals-s{seed}-e{episode}.jsonl"
+    )
+    try:
+        auto = run_sim_job("auto", seed, episode, cfg,
+                           record_path=record_path)
+        _check_invariants(static, auto, plan, cfg, dry=dry)
+        whatif = run_whatif_leg(auto, cfg)
+    finally:
+        if owned_record_dir:
+            # Caller gave us nowhere durable to put it: the replay leg
+            # has consumed the recording, don't leak ~MBs per episode.
+            shutil.rmtree(record_dir, ignore_errors=True)
     report: Dict = {
         "episode": episode,
         "seed": seed,
@@ -628,6 +828,17 @@ def run_autoscale_episode(
         "autoscale_serve_replicas_final": auto["serve_replicas_final"],
         "autoscale_fleet_grow_events": auto["serve_grow_events"],
         "autoscale_fleet_shrink_events": auto["serve_shrink_events"],
+        # §34: outcome coverage + per-cause attribution + what-if leg
+        "autoscale_outcomes_attached": auto["outcomes_attached"],
+        "autoscale_outcome_misses": auto["outcome_misses"],
+        "goodput_attributed_frac": auto["goodput_attribution"][
+            "attributed_frac"
+        ],
+        "goodput_causes": {
+            c: v["frac"]
+            for c, v in auto["goodput_attribution"]["causes"].items()
+        },
+        **whatif,
         "invariants": "pass",
     }
     if dry is not None:

@@ -77,3 +77,10 @@ def pytest_configure(config):
         "interpret mode so the CPU tier-1 lane covers kernel logic "
         "without a TPU",
     )
+    config.addinivalue_line(
+        "markers",
+        "whatif: decision-outcome observability plane (signal "
+        "recording, outcome attribution, what-if policy replay) — "
+        "docs/DESIGN.md §34; fast lane runs synthetic-recording "
+        "smokes, the record→replay→perturb soak leg is slow-lane",
+    )

@@ -752,19 +752,20 @@ def test_autoscale_episode_plan_is_deterministic():
 @pytest.mark.slow
 @pytest.mark.soak
 @pytest.mark.chaos
+@pytest.mark.whatif
 def test_autoscale_soak_episode(tmp_path):
     """The §30 acceptance run: static vs dry-run vs autoscaled under
-    one seeded fault+traffic schedule. The harness itself asserts the
-    invariants (strict goodput win, bounded time-to-mitigate, fully
-    explained ledger, zero dry-run actuations); this test pins the
-    report shape the bench keeps."""
+    one seeded fault+traffic schedule, plus the §34 leg (record →
+    replay identity → perturbed counterfactual, outcome coverage,
+    ≥90% cause attribution). The harness itself asserts the
+    invariants; this test pins the report shape the bench keeps."""
     from dlrover_tpu.testing.autoscale_soak import (
         AutoscaleSoakConfig,
         run_autoscale_episode,
     )
 
     cfg = AutoscaleSoakConfig(steps=160, watchdog_s=90.0)
-    rep = run_autoscale_episode(0, cfg=cfg)
+    rep = run_autoscale_episode(0, cfg=cfg, record_dir=str(tmp_path))
     assert rep["invariants"] == "pass"
     assert rep["autoscale_goodput_frac"] > rep["static_goodput_frac"]
     assert rep["autoscale_time_to_mitigate_s"] is not None
@@ -774,3 +775,18 @@ def test_autoscale_soak_episode(tmp_path):
     assert rep["autoscale_ckpt_retunes"] >= 1
     assert rep["autoscale_fleet_grow_events"] >= 1
     assert rep["deaths"] == 3
+    # §34: replay identity held, the perturbed policy decided
+    # differently and both counterfactuals were scored; every actuated
+    # decision carries a realized outcome; ≥90% of non-train wall time
+    # is attributed to an explicit cause.
+    assert rep["whatif_identity_ok"] is True
+    assert rep["whatif_recorded_decisions"] >= 3
+    assert (rep["whatif_perturbed_decisions"]
+            != rep["whatif_recorded_decisions"])
+    assert 0.0 <= rep["whatif_recorded_est_goodput"] <= 1.0
+    assert rep["whatif_replay_snapshots_per_s"] > 0
+    assert rep["autoscale_outcomes_attached"] >= (
+        rep["autoscale_actuations_total"]
+    )
+    assert rep["autoscale_outcome_misses"] == 0
+    assert rep["goodput_attributed_frac"] >= 0.9

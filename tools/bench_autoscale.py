@@ -54,6 +54,23 @@ def run_bench(seed: int = 0,
         "dry_run_actuations": rep.get("dry_run_actuations_total", 0),
         "deaths": rep["deaths"],
         "invariants": rep["invariants"],
+        # §34 decision-outcome plane: every actuated decision carries a
+        # realized outcome; ≥90% of non-train wall is cause-attributed;
+        # the recording replays identically and a perturbed policy
+        # yields a scored, differing counterfactual ledger.
+        "outcomes_attached": rep["autoscale_outcomes_attached"],
+        "outcome_misses": rep["autoscale_outcome_misses"],
+        "goodput_attributed_frac": rep["goodput_attributed_frac"],
+        # whatif_soak_*: the LIVE recording's replay leg — distinct
+        # from the synthetic `whatif` bench phase's whatif_identity_ok
+        # (same invariant, different provenance; must not collide).
+        "whatif_soak_identity_ok": rep["whatif_identity_ok"],
+        "whatif_soak_recorded_est_goodput": rep[
+            "whatif_recorded_est_goodput"
+        ],
+        "whatif_soak_perturbed_est_goodput": rep[
+            "whatif_perturbed_est_goodput"
+        ],
     }
 
 
