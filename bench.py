@@ -1618,6 +1618,24 @@ def serving_phase():
     return {f"serving_{k}": v for k, v in r.items()}
 
 
+def spec_decode_phase():
+    """Self-speculative decoding A/B through the real serving engines
+    (tools/bench_spec_decode.py): equal-slots spec on/off on the SAME
+    compiled base programs over a repetitive-suffix workload, b1
+    ms/accepted-token, accept-rate/tokens-per-step headline, and a
+    paged episode with allocator conservation asserted. Token parity
+    and zero retraces are asserted inside the tool. Host +
+    single-device jax — runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_spec_decode
+
+    r = bench_spec_decode.run_bench()
+    return {f"spec_{k}": v for k, v in r.items()}
+
+
 def fleet_phase():
     """Self-healing serving fleet through the real router
     (tools/bench_fleet.py): a FleetRouter over N subprocess replicas vs
@@ -1772,6 +1790,11 @@ _KEEP_KEYS = {
     "fleet_ttft_p99_s", "fleet_kill_ttft_p99_s",
     "fleet_kill_completed_frac",
     "serving_tracing_overhead_pct",
+    # §35 speculative decoding: the tokens-per-step axis — accept rate,
+    # committed tokens per verify sweep, b1 per-token cost, equal-slots
+    # serving speedup on shared compiled programs.
+    "spec_accept_rate", "spec_tokens_per_step",
+    "spec_ms_per_accepted_token_b1", "spec_serving_speedup",
     "phase_seconds", "peak_rss_mb",
     "prev_round_diff",
 }
@@ -1815,6 +1838,8 @@ _DROP_ORDER = (
     r"|attn_pallas_speedup)",
     r"^moe_(gshard|params|active|dropless_step|dropless_mfu"
     r"|gshard_mfu|dropless_wins)",
+    r"^spec_(slots|requests|drafter|drafted|accepted|b1_|retraces"
+    r"|token_exact|paged_|tokens_per_s_)",
 )
 
 _TAIL_LIMIT = 1900  # driver tail capture is 2000 chars; stay inside
@@ -1992,6 +2017,11 @@ def main():
         # model, every platform (the discipline, not the kernels, is
         # what's measured — decode_phase owns the flagship kernels).
         run_phase(result, "serving", serving_phase, est_s=60, cap_s=240)
+        # Speculative-decoding scoreboard: tokens PER step as the speed
+        # axis (§35) — spec on/off A/B on shared compiled programs.
+        run_phase(
+            result, "spec_decode", spec_decode_phase, est_s=40, cap_s=180
+        )
         # Self-healing serving fleet: router over N subprocess replicas
         # vs single-engine baseline, plus a kill-mid-run degraded run.
         # Host + CPU subprocesses, every platform.
@@ -2117,6 +2147,8 @@ def prev_round_diff(now: dict) -> dict:
         "ring_inner_speedup_s8192",
         "whatif_replay_snapshots_per_s",
         "goodput_attributed_frac",
+        "spec_tokens_per_step",
+        "spec_serving_speedup",
     )
     for path in sorted(files, key=round_no, reverse=True):
         try:

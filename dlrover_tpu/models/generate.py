@@ -376,6 +376,56 @@ def _layer_decode_read_only(
     return x, k, v
 
 
+def _layer_verify_read_only(
+    config, p, x, positions, k_cache, v_cache, cache_len,
+    k_scale=None, v_scale=None,
+):
+    """One decoder block over [b, T] tokens (speculative-decoding
+    verification: the fed token plus K drafts); the cache is read-only.
+    The T-query sibling of :func:`_layer_decode_read_only`, built on
+    ``ops.decode_attention.spec_verify_attention`` — intra-draft
+    causality rides inside the merged softmax, so T=1 is exactly the
+    single-token step.
+
+    fp caches: returns (x, k_new [b, T, kh, d], v_new). int8 caches
+    (``k_scale`` given): the new rows are quantized IN-LAYER (per-row
+    round-to-nearest — identical values to a post-scan quantize) so
+    later draft queries attend the QUANTIZED earlier-draft keys
+    exactly as sequential decode would read them back from the cache;
+    returns (x, k_q, k_rows_scale, v_q, v_rows_scale) and the caller
+    appends the quantized rows directly."""
+    from dlrover_tpu.ops.decode_attention import spec_verify_attention
+
+    residual = x
+    if "wqkv" in p:
+        q, k, v = _fused_qkv(config, p, x, positions)
+    else:
+        q, k, v = llama.attention_qkv(config, p, x, positions)
+    if k_scale is not None:
+        from dlrover_tpu.ops.kv_quant import quantize_kv
+
+        kq, ks_rows = quantize_kv(k)
+        vq, vs_rows = quantize_kv(v)
+        attn = spec_verify_attention(
+            q, k_cache, v_cache, k, v, cache_len,
+            k_scale=k_scale, v_scale=v_scale,
+            k_new_q=kq, k_new_scale=ks_rows,
+            v_new_q=vq, v_new_scale=vs_rows,
+        )
+    else:
+        attn = spec_verify_attention(
+            q, k_cache, v_cache, k, v, cache_len
+        )
+    x = llama.attention_out(config, p, attn, residual)
+    if "w_gu" in p:
+        x = _fused_mlp(config, p, x)
+    else:
+        x, _ = llama.mlp_block(config, p, x)
+    if k_scale is not None:
+        return x, kq, ks_rows, vq, vs_rows
+    return x, k, v
+
+
 def _layer_scan_unroll(n_layers: int) -> int:
     """Unroll factor for the decode-time layer scan. ROLLED is the
     measured winner: with the append-free step the rolled scan lets
@@ -539,6 +589,37 @@ def sample_token(logits, rng, temperature):
     # round two near-ties together in low precision.
     z = jnp.where(t_rows > 0.0, z + gumbel, logits)
     return jnp.argmax(z, axis=-1).astype(jnp.int32)
+
+
+def sample_token_logprobs(logits, rng, temperature, top_k: int = 0):
+    """``sample_token`` variant that ALSO returns the chosen token's
+    log-probability under the (temperature-scaled) sampling
+    distribution — and, with ``top_k > 0``, the top-k alternatives.
+
+    TOKEN-IDENTICAL to :func:`sample_token` for every (key,
+    temperature) by construction: the token comes from the same fused
+    perturbed-argmax call, and only the extra ``log_softmax`` pass over
+    the [*, V] logits is new — which is exactly why this is a separate
+    opt-in variant rather than the default hot-path sampler. The
+    speculative-decoding verifier needs it for the rejection-sampling
+    correction pick (masked residual logits in, chosen token +
+    logprob out); ``temperature <= 0`` rows report the argmax token's
+    logprob under the unscaled softmax.
+
+    Returns ``(token, logprob)``, or with ``top_k``:
+    ``(token, logprob, topk_tokens, topk_logprobs)``."""
+    tok = sample_token(logits, rng, temperature)
+    t = jnp.asarray(temperature, jnp.float32)
+    t_rows = t[..., None] if t.ndim else t
+    base = jnp.where(
+        t_rows > 0.0, logits / jnp.maximum(t_rows, 1e-6), logits
+    )
+    logp = jax.nn.log_softmax(base, axis=-1)
+    lp = jnp.take_along_axis(logp, tok[..., None], axis=-1)[..., 0]
+    if top_k:
+        tk_lp, tk_idx = jax.lax.top_k(logp, top_k)
+        return tok, lp, tk_idx.astype(jnp.int32), tk_lp
+    return tok, lp
 
 
 def prepare_decode_params(config, params):

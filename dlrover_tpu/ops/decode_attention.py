@@ -41,6 +41,115 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def spec_verify_attention(
+    q,            # [b, T, h, d] — T = 1 fed token + K drafted tokens
+    k_cache,      # [b, S, kh, d] read-only; rows >= cache_len unfilled
+    v_cache,
+    k_new,        # [b, T, kh, d] full-precision K/V of the T new tokens
+    v_new,
+    cache_len,    # [] or [b] int32 — committed (visible) cache rows
+    k_scale=None,      # [b, S, kh] f32 — int8 caches (ops/kv_quant)
+    v_scale=None,
+    k_new_q=None,      # [b, T, kh, d] int8 — quantized new K rows
+    k_new_scale=None,  # [b, T, kh] f32
+    v_new_q=None,
+    v_new_scale=None,
+):
+    """T-query generalization of the append-free decode attention —
+    the speculative-decoding VERIFICATION step's core math.
+
+    One batched call scores all T = K+1 tokens (the fed token plus K
+    drafted continuations) against a READ-ONLY ragged cache, exactly
+    what K+1 sequential ``_append_free_attention`` steps would compute
+    if each drafted token's K/V had been appended before the next
+    step. Three key groups, merged in one online softmax:
+
+    - **Cache part** ([b, S]): rows visible iff ``< cache_len``, per
+      row — the same visibility invariant as single-token decode.
+    - **Intra-draft part** ([b, T]): query t sees drafted key u iff
+      ``u < t`` (strict — the standard causal chain among the new
+      tokens). Sequential decode would read these keys FROM THE CACHE,
+      i.e. after the storage round trip; so for int8 caches the
+      off-diagonal keys here are the QUANTIZED rows (``k_new_q`` with
+      per-(row, head) ``k_new_scale`` folded post-reduction, the exact
+      read-site math of the cache part) — bit-exact int8 parity with
+      the non-speculative path.
+    - **Self part**: each query always sees its own K/V at FULL
+      precision (the write-once rule: a token's quantized row is what
+      LATER tokens read, never itself).
+
+    T=1 degenerates to ``_append_free_attention`` (the intra part is
+    empty) — the parity test pins the two. Returns [b, T, h, d].
+    """
+    b, T, h, d = q.shape
+    _, skv, kh, _ = k_cache.shape
+    g = h // kh
+    scale = d ** -0.5
+    # [b, T, kh, g, d] f32 query groups.
+    q32 = (q * scale).astype(jnp.float32).reshape(b, T, kh, g, d)
+    # Cache part: [b, kh, g, T, S]; per-row visibility masking.
+    logits = jnp.einsum(
+        "btkgd,bskd->bkgts", q32, k_cache.astype(jnp.float32)
+    )
+    if k_scale is not None:
+        logits = logits * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    lens = jnp.atleast_1d(jnp.asarray(cache_len, jnp.int32))
+    visible = jnp.arange(skv)[None, :] < lens[:, None]       # [1|b, S]
+    logits = jnp.where(visible[:, None, None, None, :], logits, NEG_INF)
+    # Intra-draft part: [b, kh, g, T, T]; key u visible to query t iff
+    # u < t. Off-diagonal keys go through the storage round trip (int8:
+    # quantized values with the scale folded post-reduction, exactly
+    # like the cache read above; fp: the cache dtype IS the compute
+    # dtype, so the round trip is the identity and k_new serves as-is).
+    intra_k = (k_new_q if k_new_q is not None else k_new).astype(
+        jnp.float32
+    )
+    l_intra = jnp.einsum("btkgd,bukd->bkgtu", q32, intra_k)
+    if k_new_scale is not None:
+        l_intra = l_intra * k_new_scale.transpose(0, 2, 1)[
+            :, :, None, None, :
+        ]
+    tq = jnp.arange(T)
+    intra_mask = tq[None, :] < tq[:, None]                   # [T, T] u<t
+    l_intra = jnp.where(intra_mask[None, None, None], l_intra, NEG_INF)
+    # Self part: full-precision own K/V.
+    l_self = jnp.einsum(
+        "btkgd,btkd->bkgt", q32, k_new.astype(jnp.float32)
+    )
+    m = jnp.maximum(
+        jnp.maximum(jnp.max(logits, axis=-1), jnp.max(l_intra, axis=-1)),
+        l_self,
+    )                                                        # [b,kh,g,T]
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where(visible[:, None, None, None, :], p, 0.0)
+    p_intra = jnp.exp(l_intra - m[..., None])
+    p_intra = jnp.where(intra_mask[None, None, None], p_intra, 0.0)
+    p_self = jnp.exp(l_self - m)
+    denom = (
+        jnp.sum(p, axis=-1) + jnp.sum(p_intra, axis=-1) + p_self
+    )                                                        # >= p_self
+    pv = p if v_scale is None else (
+        p * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    )
+    intra_v = (v_new_q if v_new_q is not None else v_new).astype(
+        jnp.float32
+    )
+    pv_intra = p_intra if v_new_scale is None else (
+        p_intra * v_new_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    )
+    out = (
+        jnp.einsum("bkgts,bskd->bkgtd", pv, v_cache.astype(jnp.float32))
+        + jnp.einsum("bkgtu,bukd->bkgtd", pv_intra, intra_v)
+        + p_self[..., None] * v_new.astype(jnp.float32).transpose(
+            0, 2, 1, 3
+        )[:, :, None]
+    ) / denom[..., None]
+    # [b, kh, g, T, d] -> [b, T, h, d]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, T, h, d).astype(
+        q.dtype
+    )
+
+
 def _decode_body(
     len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
     o_ref, m_ref, l_ref, acc_ref, *, block_k: int, scale: float,

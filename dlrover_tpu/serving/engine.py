@@ -53,6 +53,7 @@ from dlrover_tpu.models import generate as gen_lib
 from dlrover_tpu.observability import tracing
 from dlrover_tpu.models import llama
 from dlrover_tpu.serving import scheduler as sched_lib
+from dlrover_tpu.serving import spec_decode as spec_lib
 from dlrover_tpu.serving.metrics import serving_metrics
 from dlrover_tpu.serving.scheduler import DECODE, PREFILL, Request, Scheduler
 
@@ -60,6 +61,24 @@ from dlrover_tpu.serving.scheduler import DECODE, PREFILL, Request, Scheduler
 class _CompiledSteps(NamedTuple):
     prefill: object
     decode: object
+    trace_counts: Dict[str, int]
+
+
+# Lookback horizon for the host n-gram drafter: the rightmost suffix
+# match decides the proposal, so only recent history can win — and the
+# per-step host cost must stay flat as sequences grow.
+_NGRAM_WINDOW = 128
+
+
+class _SpecSteps(NamedTuple):
+    """Speculative-decoding programs, compiled SEPARATELY from the
+    base prefill/decode pair: a spec-on and a spec-off engine with the
+    same (config, slots, max_len, chunk) share one _CompiledSteps entry
+    — the bench's spec A/B genuinely runs on the same compiled base
+    programs, and spec_k changes can't invalidate them."""
+
+    verify: object
+    draft: object        # None for the host-side n-gram drafter
     trace_counts: Dict[str, int]
 
 
@@ -156,6 +175,132 @@ def _build_prefill_chunk(config, slots: int, max_len: int, chunk: int,
     return prefill
 
 
+def _build_verify_step(config, slots: int, max_len: int, K: int, counts):
+    """[slots] fed tokens + [slots, K] drafts -> accepted tokens, one
+    batched pass. T = K+1 queries run the SAME ragged append-free
+    attention as the decode step (generalized to multiple queries with
+    an intra-draft causal mask — models/generate._layer_verify_read_
+    only), all T rows' K/V land with one per-row scatter at rows
+    fill..fill+K, and the accept/reject law (spec_decode.spec_accept)
+    picks how many drafts survive. Rows past an accepted prefix stay
+    beyond the advanced fill — rollback is the fill rewind, no cleanup
+    pass exists. Writes past max_len drop (``mode="drop"``): near the
+    boundary the host clamps draft_len so no DROPPED row can ever
+    become visible."""
+    T = K + 1
+
+    def verify(k, v, params, lengths, tokens, drafts, draft_len,
+               active, temps, rng, step_idx):
+        counts["verify"] += 1  # traces only
+        toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        positions = (
+            lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        )
+        x = llama.embed_tokens(config, params, toks)
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            y, k_new, v_new = gen_lib._layer_verify_read_only(
+                config, pl, carry, positions, k_c, v_c, lengths
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v)
+        )
+        row = jnp.arange(slots)[:, None]
+        writes = positions                                # [slots, T]
+        k = k.at[:, row, writes].set(
+            k_news.astype(k.dtype), mode="drop"
+        )
+        v = v.at[:, row, writes].set(
+            v_news.astype(v.dtype), mode="drop"
+        )
+        logits = llama.unembed(config, params, x)         # [slots, T, V]
+        emitted, acc = spec_lib.spec_accept(
+            logits, drafts, draft_len, temps, active, tokens,
+            rng, step_idx,
+        )
+        return k, v, emitted, acc
+
+    return verify
+
+
+def _build_draft_step(config, slots: int, max_len: int, K: int,
+                      draft_layers: int, counts):
+    """Early-exit drafter: K sequential single-token forwards through
+    the FIRST ``draft_layers`` decoder blocks of the same weights
+    (greedy argmax through the shared final-norm/unembed head). Each
+    drafted token's partial-layer K/V lands at its row beyond the fill
+    so the NEXT draft can attend it — invisible to everyone else by
+    the visibility invariant, and the verify pass rewrites those rows
+    with full-model K/V for every layer before any of them can become
+    visible. Out-of-range writes drop."""
+    d = draft_layers
+
+    def draft(k, v, params, lengths, tokens, active):
+        counts["draft"] += 1  # traces only
+        layers_d = jax.tree_util.tree_map(
+            lambda a: a[:d], params["layers"]
+        )
+        row = jnp.arange(slots)
+        cur = tokens
+        drafts = []
+        for i in range(K):
+            lens_i = lengths + i
+            positions = lens_i[:, None]
+            x = llama.embed_tokens(config, params, cur[:, None])
+
+            def body(carry, layer_in):
+                pl, k_c, v_c = layer_in
+                y, k_new, v_new = gen_lib._layer_decode_read_only(
+                    config, pl, carry, positions, k_c, v_c, lens_i
+                )
+                return y, (k_new, v_new)
+
+            x, (k_news, v_news) = jax.lax.scan(
+                body, x, (layers_d, k[:d], v[:d])
+            )
+            k = k.at[:d, row, lens_i].set(
+                k_news[:, :, 0].astype(k.dtype), mode="drop"
+            )
+            v = v.at[:d, row, lens_i].set(
+                v_news[:, :, 0].astype(v.dtype), mode="drop"
+            )
+            logits = llama.unembed(config, params, x)[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            cur = jnp.where(active, nxt, cur)
+            drafts.append(cur)
+        return k, v, jnp.stack(drafts, axis=1)           # [slots, K]
+
+    return draft
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_spec_steps(
+    config: llama.TpuLMConfig, slots: int, max_len: int,
+    spec_k: int, draft_layers: int,
+) -> _SpecSteps:
+    """Verify (+ optional early-exit draft) programs, one per shape
+    key, KV slabs donated — the spec siblings of _compiled_steps.
+    spec_k is a SHAPE key (the verify batch is [slots, K+1]); the
+    per-slot accept length rides as a traced vector, so variable
+    acceptance never retraces."""
+    counts = {"verify": 0, "draft": 0}
+    verify = jax.jit(
+        _build_verify_step(config, slots, max_len, spec_k, counts),
+        donate_argnums=(0, 1),
+    )
+    draft = None
+    if draft_layers > 0:
+        draft = jax.jit(
+            _build_draft_step(config, slots, max_len, spec_k,
+                              draft_layers, counts),
+            donate_argnums=(0, 1),
+        )
+    return _SpecSteps(verify=verify, draft=draft, trace_counts=counts)
+
+
 @functools.lru_cache(maxsize=16)
 def _compiled_steps(
     config: llama.TpuLMConfig, slots: int, max_len: int, chunk: int
@@ -197,6 +342,9 @@ class ServingEngine:
         registry=None,
         max_requeues: int = 3,
         slo_classes=None,
+        spec_k: int = 0,
+        spec_drafter: str = "ngram",
+        spec_draft_layers: int = 2,
     ):
         if config.pp_stages > 1:
             raise NotImplementedError(
@@ -218,10 +366,32 @@ class ServingEngine:
                 f"max_len {max_len} must be a multiple of "
                 f"prefill_chunk {prefill_chunk}"
             )
+        if spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        if spec_k:
+            if spec_drafter not in spec_lib.SPEC_DRAFTERS:
+                raise ValueError(
+                    f"spec_drafter must be one of "
+                    f"{spec_lib.SPEC_DRAFTERS}, got {spec_drafter!r}"
+                )
+            if spec_drafter == "early_exit" and not (
+                0 < spec_draft_layers <= config.n_layers
+            ):
+                raise ValueError(
+                    f"spec_draft_layers must be in 1..{config.n_layers}"
+                )
         self.config = config
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
+        self.spec_k = spec_k
+        self.spec_drafter = spec_drafter
+        # draft_layers keys the compile cache; 0 = no device drafter
+        # (the n-gram drafter is pure host code).
+        self.spec_draft_layers = (
+            spec_draft_layers
+            if spec_k and spec_drafter == "early_exit" else 0
+        )
         # How many step-error restarts a request gets before it is
         # EXPLICITLY failed — a persistent device error must not
         # livelock the serve loop re-queueing the same work forever.
@@ -229,13 +399,28 @@ class ServingEngine:
         self.scheduler = Scheduler(
             slots, max_len, prefill_chunk, token_budget, drain_mode,
             slo_classes=slo_classes,
+            decode_tokens_per_slot=1 + spec_k,
         )
         self.metrics = serving_metrics(registry)
         self.metrics.slots_total.set(slots)
         self._params = gen_lib.prepare_decode_params(config, params)
         self._steps = _compiled_steps(config, slots, max_len,
                                       prefill_chunk)
-        self._trace_snapshot = dict(self._steps.trace_counts)
+        self._spec = (
+            _compiled_spec_steps(config, slots, max_len, spec_k,
+                                 self.spec_draft_layers)
+            if spec_k else None
+        )
+        # Running accepted-tokens-per-step mean (slot-steps in the
+        # denominator: one decoding slot through one verify call).
+        self._spec_emitted = 0
+        self._spec_slot_steps = 0
+        # Per-iteration emitted-token counts, one entry per decoding
+        # slot — step() turns them into per-TOKEN latency observations
+        # (a verify step that commits 4 tokens is 4 cheap tokens, not
+        # one slow one).
+        self._iter_advance: List[int] = []
+        self._trace_snapshot = self._all_trace_counts()
         self._rng = rng if rng is not None else jax.random.key(0)
         self._step_idx = 0
         self._k, self._v = self._fresh_pool()
@@ -256,11 +441,19 @@ class ServingEngine:
 
     # ---- public API --------------------------------------------------------
 
+    def _all_trace_counts(self) -> Dict[str, int]:
+        """Base + spec compile counters merged (key sets are disjoint:
+        prefill/decode/... vs verify/draft)."""
+        counts = dict(self._steps.trace_counts)
+        if self._spec is not None:
+            counts.update(self._spec.trace_counts)
+        return counts
+
     @property
     def trace_counts(self) -> Dict[str, int]:
         """Compile counter per step program (shared across engines with
         the same shape key) — flat after warmup or something retraced."""
-        return dict(self._steps.trace_counts)
+        return self._all_trace_counts()
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
@@ -316,10 +509,26 @@ class ServingEngine:
             jnp.asarray(np.zeros(self.slots, np.float32)),
             self._rng, np.int32(0),
         )
+        if self._spec is not None:
+            z_i = jnp.asarray(np.zeros(self.slots, np.int32))
+            z_b = jnp.asarray(np.zeros(self.slots, bool))
+            z_f = jnp.asarray(np.zeros(self.slots, np.float32))
+            drafts = jnp.asarray(
+                np.zeros((self.slots, self.spec_k), np.int32)
+            )
+            if self._spec.draft is not None:
+                k, v, drafts = self._spec.draft(
+                    k, v, self._params, z_i, z_i, z_b
+                )
+            k, v, _, acc = self._spec.verify(
+                k, v, self._params, z_i, z_i, drafts, z_i, z_b, z_f,
+                self._rng, np.int32(0),
+            )
+            nxt = acc
         jax.block_until_ready(nxt)
         del k, v
         self._k, self._v = self._fresh_pool()
-        self._trace_snapshot = dict(self._steps.trace_counts)
+        self._trace_snapshot = self._all_trace_counts()
 
     def step(self) -> List[Request]:
         """One scheduler iteration: admissions, at most one prefill
@@ -328,6 +537,7 @@ class ServingEngine:
         t0 = time.monotonic()
         sch = self.scheduler
         finished: List[Request] = []
+        self._iter_advance = []
         for req in sch.shed_expired(t0):
             # Past-deadline queued work is an explicit terminal outcome,
             # surfaced through step()'s return like any completion.
@@ -357,7 +567,7 @@ class ServingEngine:
                 self._run_decode(decoding, finished)
         except Exception as e:  # noqa: BLE001 — device/XLA errors vary
             self._recover_from_step_error(e, finished)
-            decoding = []
+            self._iter_advance = []
         self._step_idx += 1
         self.metrics.iterations.inc()
         self.metrics.queue_depth.set(len(sch.queue))
@@ -366,10 +576,17 @@ class ServingEngine:
         self.metrics.active_slots.set(len(sch.active()))
         self._sync_pool_metrics()
         self._sync_retrace_metric()
-        if decoding:
+        if self._iter_advance:
+            # One observation PER EMITTED TOKEN at the per-token cost,
+            # not one per iteration at the full wall time — a verify
+            # step committing 4 tokens per slot must read as 4 fast
+            # tokens, or spec decode would look SLOWER per token the
+            # better it performs.
             dt = time.monotonic() - t0
-            for _ in decoding:
-                self.metrics.token_latency.observe(dt)
+            per_tok = dt / sum(self._iter_advance)
+            for adv in self._iter_advance:
+                for _ in range(adv):
+                    self.metrics.token_latency.observe(per_tok)
         return finished
 
     def run_until_idle(self, max_iters: int = 100000) -> List[Request]:
@@ -508,6 +725,9 @@ class ServingEngine:
 
     def _run_decode(self, decoding: List[Request],
                     finished: List[Request]):
+        if self.spec_k:
+            self._run_decode_spec(decoding, finished)
+            return
         active = np.zeros(self.slots, bool)
         for r in decoding:
             active[r.slot] = True
@@ -524,12 +744,152 @@ class ServingEngine:
             r.tokens.append(tok)
             self._tokens[r.slot] = tok
             self.metrics.tokens.inc(kind="decode")
+            self._iter_advance.append(1)
             if len(r.tokens) >= r.max_new_tokens:
                 self._finish(r, finished)
             elif self._lengths[r.slot] + 1 > self.max_len:
                 # No room to feed the token just sampled.
                 r.truncated = True
                 self._finish(r, finished)
+
+    # ---- speculative decode (§35) ------------------------------------------
+
+    def _run_decode_spec(self, decoding: List[Request],
+                         finished: List[Request]):
+        """One draft → verify → commit iteration for every decoding
+        slot. The verify program replaces the decode program entirely
+        while spec is on (draft_len 0 degenerates to plain one-token
+        decode), so variable per-slot acceptance is just a ragged fill
+        advance — the SAME continuous-batching law as everything else,
+        zero retraces. Rollback of rejected drafts is the fill NOT
+        advancing past them."""
+        decoding = self._spec_prepare_rows(decoding)
+        if not decoding:
+            return
+        active = np.zeros(self.slots, bool)
+        for r in decoding:
+            active[r.slot] = True
+        t_d = time.monotonic()
+        drafts, draft_len = self._spec_draft(decoding, active)
+        t_v = time.monotonic()
+        emitted, acc = self._spec_verify_device(active, drafts,
+                                                draft_len)
+        emitted = np.asarray(jax.device_get(emitted))
+        acc = np.asarray(jax.device_get(acc))
+        t_e = time.monotonic()
+        n_dec = len(decoding)
+        d_dt = (t_v - t_d) / n_dec
+        v_dt = (t_e - t_v) / n_dec
+        for r in decoding:
+            r.draft_s += d_dt
+            r.verify_s += v_dt
+        for r in decoding:
+            s = r.slot
+            n_acc = int(acc[s])
+            dl = int(draft_len[s])
+            toks = [int(t) for t in emitted[s, : n_acc + 1]]
+            # All T rows' KV landed; only the accepted prefix plus the
+            # final token become visible — the rest sits beyond the
+            # fill (free rollback).
+            self._lengths[s] += n_acc + 1
+            r.tokens.extend(toks)
+            self._tokens[s] = toks[-1]
+            r.spec_drafted += dl
+            r.spec_accepted += n_acc
+            self.metrics.tokens.inc(n_acc + 1, kind="decode")
+            if dl:
+                self.metrics.spec_tokens.inc(dl, kind="drafted")
+                if n_acc:
+                    self.metrics.spec_tokens.inc(n_acc, kind="accepted")
+                if dl - n_acc:
+                    self.metrics.spec_tokens.inc(dl - n_acc,
+                                                 kind="rejected")
+                self.metrics.spec_accept_rate.observe(n_acc / dl)
+            self._spec_emitted += n_acc + 1
+            self._spec_slot_steps += 1
+            self._iter_advance.append(n_acc + 1)
+            if len(r.tokens) >= r.max_new_tokens:
+                self._finish(r, finished)
+            elif self._lengths[s] + 1 > self.max_len:
+                # No room to feed the final token back.
+                r.truncated = True
+                self._finish(r, finished)
+        self.metrics.spec_tokens_per_step.set(
+            self._spec_emitted / self._spec_slot_steps
+        )
+
+    def _spec_prepare_rows(self, decoding: List[Request]):
+        """Make rows fill..fill+spec_k writable for every decoding
+        slot. The flat slab always has them (each slot owns [max_len]
+        rows); the paged engine allocates/privatizes blocks here and
+        may preempt."""
+        return decoding
+
+    def _spec_draft(self, decoding: List[Request], active):
+        """Propose up to spec_k tokens per slot. Returns
+        ``(drafts [slots, K], draft_len np[slots])`` — drafts may live
+        on device (early exit) or host (n-gram)."""
+        K = self.spec_k
+        draft_len = np.zeros(self.slots, np.int32)
+        caps = {
+            r.slot: spec_lib.clamp_draft_len(
+                K, len(r.tokens), r.max_new_tokens,
+                int(self._lengths[r.slot]), self.max_len,
+            )
+            for r in decoding
+        }
+        if self.spec_drafter == "early_exit":
+            drafts = self._spec_draft_device(active)
+            # The device drafter always proposes K tokens; the clamp
+            # rides in draft_len (acceptance never crosses it).
+            jax.block_until_ready(drafts)  # honest draft/verify split
+            for s, cap in caps.items():
+                draft_len[s] = cap
+            return drafts, draft_len
+        drafts_np = np.zeros((self.slots, K), np.int32)
+        window = _NGRAM_WINDOW
+        for r in decoding:
+            s = r.slot
+            cap = caps[s]
+            if cap <= 0:
+                continue
+            # Bounded lookback: the rightmost match is what wins, and
+            # the motifs worth speculating on recur within a short
+            # horizon — an unbounded concat would make the host draft
+            # cost grow with sequence length every step.
+            toks = r.tokens
+            if len(toks) >= window:
+                hist = np.asarray(toks[-window:], np.int32)
+            else:
+                hist = np.concatenate([
+                    np.asarray(
+                        r.prompt[-(window - len(toks)):], np.int32
+                    ),
+                    np.asarray(toks, np.int32),
+                ])
+            prop = spec_lib.propose_ngram(hist, cap)
+            n = min(len(prop), cap)
+            drafts_np[s, :n] = prop[:n]
+            draft_len[s] = n
+        return drafts_np, draft_len
+
+    def _spec_draft_device(self, active):
+        self._k, self._v, drafts = self._spec.draft(
+            self._k, self._v, self._params,
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(active),
+        )
+        return drafts
+
+    def _spec_verify_device(self, active, drafts, draft_len):
+        self._k, self._v, emitted, acc = self._spec.verify(
+            self._k, self._v, self._params,
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(drafts), jnp.asarray(draft_len),
+            jnp.asarray(active), jnp.asarray(self._temps),
+            self._rng, np.int32(self._step_idx),
+        )
+        return emitted, acc
 
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
@@ -596,13 +956,30 @@ class ServingEngine:
             "serving.prefill", req.admit_ts, req.first_token_ts,
             parent=root, attrs={"prompt_len": req.prompt_len},
         )
-        tracer.record_span(
+        decode_span = tracer.record_span(
             "serving.decode", req.first_token_ts, finish,
             parent=root, attrs={"new_tokens": len(req.tokens)},
         )
+        if req.verify_s > 0.0:
+            # Spec decode splits the decode phase into draft / verify
+            # sub-spans (per-slot shares of the iteration wall time,
+            # laid contiguously — durations are the signal, not the
+            # absolute placement).
+            td = min(req.first_token_ts + req.draft_s, finish)
+            tv = min(td + req.verify_s, finish)
+            tracer.record_span(
+                "serving.decode.draft", req.first_token_ts, td,
+                parent=decode_span,
+                attrs={"spec_drafted": req.spec_drafted},
+            )
+            tracer.record_span(
+                "serving.decode.verify", td, tv,
+                parent=decode_span,
+                attrs={"spec_accepted": req.spec_accepted},
+            )
 
     def _sync_retrace_metric(self):
-        now = self._steps.trace_counts
+        now = self._all_trace_counts()
         delta = sum(now.values()) - sum(self._trace_snapshot.values())
         if delta > 0:
             self.metrics.retraces.inc(delta)

@@ -65,6 +65,7 @@ from dlrover_tpu.serving.kvpool.allocator import (
     BlockPoolExhausted,
 )
 from dlrover_tpu.serving.kvpool.prefix_cache import PrefixCache
+from dlrover_tpu.serving import spec_decode as spec_lib
 from dlrover_tpu.serving.scheduler import DECODE, PREFILL, Request
 
 # Pool row 0 absorbs the masked-garbage appends of non-active slots;
@@ -76,6 +77,17 @@ class _PagedSteps(NamedTuple):
     prefill: object
     decode: object
     cow: object
+    trace_counts: Dict[str, int]
+
+
+class _PagedSpecSteps(NamedTuple):
+    """Speculative verify/draft programs over the block pool —
+    compiled separately from _PagedSteps for the same reason as the
+    flat engine's _SpecSteps: spec on/off engines share the base
+    programs."""
+
+    verify: object
+    draft: object        # None for the host-side n-gram drafter
     trace_counts: Dict[str, int]
 
 
@@ -297,6 +309,240 @@ def _build_cow_copy(counts, quantized: bool = False):
     return cow_q8 if quantized else cow
 
 
+def _build_paged_verify(config, slots: int, max_blocks: int,
+                        block_size: int, K: int, counts,
+                        quantized: bool = False):
+    """Paged sibling of serving.engine._build_verify_step: the T = K+1
+    verification queries gather each slot's logical cache through its
+    block table and all T new rows land via one advanced-index scatter
+    at block coordinates. Invalid writes (inactive slot, or a row at or
+    past max_len) are redirected to the sentinel block — the paged
+    engine's version of ``mode="drop"``; the host guarantees the rows
+    that CAN become visible (fill..fill+accept) sit in allocated,
+    privately-owned blocks (_spec_prepare_rows). ``quantized``: the
+    layer quantizes its new rows IN-LAYER (per-row round-to-nearest, so
+    intra-draft reads see exactly the values a sequential step would
+    read back from the int8 cache — the bit-stability rule, §35) and
+    the scatter appends the quantized rows + scales directly."""
+    max_len = max_blocks * block_size
+    kh, hd = config.n_kv_heads, config.head_dim
+    T = K + 1
+
+    def _verify_coords(tables, lengths, active):
+        writes = (
+            lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        )                                                # [slots, T]
+        valid = active[:, None] & (writes < max_len)
+        w = jnp.minimum(writes, max_len - 1)
+        blk = jnp.take_along_axis(tables, w // block_size, axis=1)
+        blk = jnp.where(valid, blk, SENTINEL_BLOCK)
+        off = jnp.where(valid, w % block_size, 0)
+        # Several invalid columns may collapse onto sentinel (0, 0);
+        # duplicate scatter targets are fine — it is garbage writing
+        # over garbage in a block that is never read.
+        return blk, off
+
+    def verify(k, v, params, tables, lengths, tokens, drafts,
+               draft_len, active, temps, rng, step_idx):
+        counts["verify"] += 1  # traces only
+        toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        positions = (
+            lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        )
+        x = llama.embed_tokens(config, params, toks)
+
+        def body(carry, layer_in):
+            pl, k_c, v_c = layer_in
+            k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+            v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+            y, k_new, v_new = gen_lib._layer_verify_read_only(
+                config, pl, carry, positions, k_view, v_view, lengths
+            )
+            return y, (k_new, v_new)
+
+        x, (k_news, v_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v)
+        )
+        blk, off = _verify_coords(tables, lengths, active)
+        k = k.at[:, blk, off].set(k_news.astype(k.dtype))
+        v = v.at[:, blk, off].set(v_news.astype(v.dtype))
+        logits = llama.unembed(config, params, x)        # [slots, T, V]
+        emitted, acc = spec_lib.spec_accept(
+            logits, drafts, draft_len, temps, active, tokens,
+            rng, step_idx,
+        )
+        return k, v, emitted, acc
+
+    def verify_q8(k, v, ks, vs, params, tables, lengths, tokens,
+                  drafts, draft_len, active, temps, rng, step_idx):
+        counts["verify"] += 1  # traces only
+        toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        positions = (
+            lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        )
+        x = llama.embed_tokens(config, params, toks)
+
+        def body(carry, layer_in):
+            pl, k_c, v_c, ks_c, vs_c = layer_in
+            k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+            v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+            ks_view = ks_c[tables].reshape(slots, max_len, kh)
+            vs_view = vs_c[tables].reshape(slots, max_len, kh)
+            y, kq, ks_rows, vq, vs_rows = (
+                gen_lib._layer_verify_read_only(
+                    config, pl, carry, positions, k_view, v_view,
+                    lengths, k_scale=ks_view, v_scale=vs_view,
+                )
+            )
+            return y, (kq, ks_rows, vq, vs_rows)
+
+        x, (kqs, ks_news, vqs, vs_news) = jax.lax.scan(
+            body, x, (params["layers"], k, v, ks, vs)
+        )
+        blk, off = _verify_coords(tables, lengths, active)
+        k = k.at[:, blk, off].set(kqs)
+        v = v.at[:, blk, off].set(vqs)
+        ks = ks.at[:, blk, off].set(ks_news)
+        vs = vs.at[:, blk, off].set(vs_news)
+        logits = llama.unembed(config, params, x)
+        emitted, acc = spec_lib.spec_accept(
+            logits, drafts, draft_len, temps, active, tokens,
+            rng, step_idx,
+        )
+        return k, v, ks, vs, emitted, acc
+
+    return verify_q8 if quantized else verify
+
+
+def _build_paged_draft(config, slots: int, max_blocks: int,
+                       block_size: int, K: int, draft_layers: int,
+                       counts, quantized: bool = False):
+    """Paged early-exit drafter: K sequential single-token partial
+    forwards (first ``draft_layers`` blocks) through the block-table
+    gather; each drafted row's partial-layer K/V is appended beyond
+    the fill (sentinel-redirected when invalid) so the next draft can
+    attend it. The verify pass rewrites all layers of those rows
+    before any can become visible."""
+    max_len = max_blocks * block_size
+    kh, hd = config.n_kv_heads, config.head_dim
+    d = draft_layers
+
+    def _coords(tables, lens_i, active):
+        valid = active & (lens_i < max_len)
+        w = jnp.minimum(lens_i, max_len - 1)
+        blk = jnp.take_along_axis(
+            tables, (w // block_size)[:, None], axis=1
+        )[:, 0]
+        blk = jnp.where(valid, blk, SENTINEL_BLOCK)
+        off = jnp.where(valid, w % block_size, 0)
+        return blk, off
+
+    def draft(k, v, params, tables, lengths, tokens, active):
+        counts["draft"] += 1  # traces only
+        layers_d = jax.tree_util.tree_map(
+            lambda a: a[:d], params["layers"]
+        )
+        cur = tokens
+        drafts = []
+        for i in range(K):
+            lens_i = lengths + i
+            positions = lens_i[:, None]
+            x = llama.embed_tokens(config, params, cur[:, None])
+
+            def body(carry, layer_in):
+                pl, k_c, v_c = layer_in
+                k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+                v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+                y, k_new, v_new = gen_lib._layer_decode_read_only(
+                    config, pl, carry, positions, k_view, v_view,
+                    lens_i,
+                )
+                return y, (k_new, v_new)
+
+            x, (k_news, v_news) = jax.lax.scan(
+                body, x, (layers_d, k[:d], v[:d])
+            )
+            blk, off = _coords(tables, lens_i, active)
+            k = k.at[:d, blk, off].set(k_news[:, :, 0].astype(k.dtype))
+            v = v.at[:d, blk, off].set(v_news[:, :, 0].astype(v.dtype))
+            logits = llama.unembed(config, params, x)[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            cur = jnp.where(active, nxt, cur)
+            drafts.append(cur)
+        return k, v, jnp.stack(drafts, axis=1)
+
+    def draft_q8(k, v, ks, vs, params, tables, lengths, tokens,
+                 active):
+        from dlrover_tpu.ops.kv_quant import quantize_kv
+
+        counts["draft"] += 1  # traces only
+        layers_d = jax.tree_util.tree_map(
+            lambda a: a[:d], params["layers"]
+        )
+        cur = tokens
+        drafts = []
+        for i in range(K):
+            lens_i = lengths + i
+            positions = lens_i[:, None]
+            x = llama.embed_tokens(config, params, cur[:, None])
+
+            def body(carry, layer_in):
+                pl, k_c, v_c, ks_c, vs_c = layer_in
+                k_view = k_c[tables].reshape(slots, max_len, kh, hd)
+                v_view = v_c[tables].reshape(slots, max_len, kh, hd)
+                ks_view = ks_c[tables].reshape(slots, max_len, kh)
+                vs_view = vs_c[tables].reshape(slots, max_len, kh)
+                y, k_new, v_new = gen_lib._layer_decode_read_only(
+                    config, pl, carry, positions, k_view, v_view,
+                    lens_i, k_scale=ks_view, v_scale=vs_view,
+                )
+                return y, (k_new, v_new)
+
+            x, (k_news, v_news) = jax.lax.scan(
+                body, x, (layers_d, k[:d], v[:d], ks[:d], vs[:d])
+            )
+            blk, off = _coords(tables, lens_i, active)
+            kq, ks_rows = quantize_kv(k_news[:, :, 0])
+            vq, vs_rows = quantize_kv(v_news[:, :, 0])
+            k = k.at[:d, blk, off].set(kq)
+            v = v.at[:d, blk, off].set(vq)
+            ks = ks.at[:d, blk, off].set(ks_rows)
+            vs = vs.at[:d, blk, off].set(vs_rows)
+            logits = llama.unembed(config, params, x)[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            cur = jnp.where(active, nxt, cur)
+            drafts.append(cur)
+        return k, v, ks, vs, jnp.stack(drafts, axis=1)
+
+    return draft_q8 if quantized else draft
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_spec_steps(
+    config: llama.TpuLMConfig, slots: int, num_blocks: int,
+    max_blocks: int, block_size: int, spec_k: int, draft_layers: int,
+    kv_dtype: str = "fp",
+) -> _PagedSpecSteps:
+    counts = {"verify": 0, "draft": 0}
+    quantized = kv_dtype == "int8"
+    pool_args = (0, 1, 2, 3) if quantized else (0, 1)
+    verify = jax.jit(
+        _build_paged_verify(config, slots, max_blocks, block_size,
+                            spec_k, counts, quantized=quantized),
+        donate_argnums=pool_args,
+    )
+    draft = None
+    if draft_layers > 0:
+        draft = jax.jit(
+            _build_paged_draft(config, slots, max_blocks, block_size,
+                               spec_k, draft_layers, counts,
+                               quantized=quantized),
+            donate_argnums=pool_args,
+        )
+    return _PagedSpecSteps(verify=verify, draft=draft,
+                           trace_counts=counts)
+
+
 @functools.lru_cache(maxsize=16)
 def _paged_steps(
     config: llama.TpuLMConfig, slots: int, num_blocks: int,
@@ -360,6 +606,9 @@ class PagedServingEngine(ServingEngine):
         max_requeues: int = 3,
         slo_classes=None,
         kv_cache_dtype: str = "fp",
+        spec_k: int = 0,
+        spec_drafter: str = "ngram",
+        spec_draft_layers: int = 2,
     ):
         if kv_cache_dtype not in ("fp", "int8"):
             raise ValueError(
@@ -418,6 +667,8 @@ class PagedServingEngine(ServingEngine):
             prefill_chunk=prefill_chunk, token_budget=token_budget,
             drain_mode=drain_mode, rng=rng, registry=registry,
             max_requeues=max_requeues, slo_classes=slo_classes,
+            spec_k=spec_k, spec_drafter=spec_drafter,
+            spec_draft_layers=spec_draft_layers,
         )
         self._kscale, self._vscale = self._fresh_scales()
         # Block watermark: only admit a request the pool can hold
@@ -432,7 +683,15 @@ class PagedServingEngine(ServingEngine):
             config, slots, self.num_blocks, self.max_blocks,
             block_size, prefill_chunk, kv_dtype=kv_cache_dtype,
         )
-        self._trace_snapshot = dict(self._steps.trace_counts)
+        if self.spec_k:
+            # Same swap for the spec programs (the flat ones the base
+            # __init__ bound were never traced — jit is lazy).
+            self._spec = _paged_spec_steps(
+                config, slots, self.num_blocks, self.max_blocks,
+                block_size, self.spec_k, self.spec_draft_layers,
+                kv_dtype=kv_cache_dtype,
+            )
+        self._trace_snapshot = self._all_trace_counts()
         # K+V bytes per block, for the HBM-in-use gauge: int8 pools
         # pay 1 byte/element + one f32 scale per (row, head) — the
         # 1.94x-per-token capacity lever the equal-HBM bench exploits.
@@ -519,11 +778,30 @@ class PagedServingEngine(ServingEngine):
             self._rng, np.int32(0),
         )
         pools = self._steps.cow(*pools, np.int32(0), np.int32(0))
+        if self._spec is not None:
+            tbl = jnp.asarray(
+                np.zeros((self.slots, self.max_blocks), np.int32)
+            )
+            z_i = jnp.asarray(np.zeros(self.slots, np.int32))
+            z_b = jnp.asarray(np.zeros(self.slots, bool))
+            z_f = jnp.asarray(np.zeros(self.slots, np.float32))
+            drafts = jnp.asarray(
+                np.zeros((self.slots, self.spec_k), np.int32)
+            )
+            if self._spec.draft is not None:
+                *pools, drafts = self._spec.draft(
+                    *pools, self._params, tbl, z_i, z_i, z_b
+                )
+            *pools, _em, acc = self._spec.verify(
+                *pools, self._params, tbl, z_i, z_i, drafts, z_i,
+                z_b, z_f, self._rng, np.int32(0),
+            )
+            jax.block_until_ready(acc)
         jax.block_until_ready(pools[-1])
         del pools
         self._k, self._v = self._fresh_pool()
         self._kscale, self._vscale = self._fresh_scales()
-        self._trace_snapshot = dict(self._steps.trace_counts)
+        self._trace_snapshot = self._all_trace_counts()
 
     # ---- block bookkeeping -------------------------------------------------
 
@@ -763,6 +1041,9 @@ class PagedServingEngine(ServingEngine):
 
     def _run_decode(self, decoding: List[Request],
                     finished: List[Request]):
+        if self.spec_k:
+            self._run_decode_spec(decoding, finished)
+            return
         # Block-budget pass FIRST: growing a cursor past a block edge
         # may preempt the youngest peer, which must then sit this
         # iteration out.
@@ -792,11 +1073,57 @@ class PagedServingEngine(ServingEngine):
             r.tokens.append(tok)
             self._tokens[r.slot] = tok
             self.metrics.tokens.inc(kind="decode")
+            self._iter_advance.append(1)
             if len(r.tokens) >= r.max_new_tokens:
                 self._finish(r, finished)
             elif self._lengths[r.slot] + 1 > self.max_len:
                 r.truncated = True
                 self._finish(r, finished)
+
+    # ---- speculative decode hooks (§35) ------------------------------------
+
+    def _spec_prepare_rows(self, decoding: List[Request]):
+        """Every decoding slot needs rows fill..fill+spec_k writable
+        BEFORE the device calls: allocate the covering blocks (relief
+        ladder may preempt the youngest peer, which then sits this
+        iteration out) and privatize every touched block — drafted-
+        then-rejected rows must never land in a block another slot or
+        the prefix cache shares."""
+        T = self.spec_k + 1
+        for r in list(decoding):
+            if r.state != DECODE:
+                continue  # preempted by an earlier peer's allocation
+            fill = int(self._lengths[r.slot])
+            upto = min(fill + T, self.max_len)
+            self._ensure_blocks(r, upto)
+            first_blk = min(fill, self.max_len - 1) // self.block_size
+            last_blk = min(
+                (upto - 1) // self.block_size,
+                len(self._slot_blocks[r.slot]) - 1,
+            )
+            for idx in range(first_blk, last_blk + 1):
+                self._privatize(r, idx)
+        return [r for r in decoding if r.state == DECODE]
+
+    def _spec_draft_device(self, active):
+        *pools, drafts = self._spec.draft(
+            *self._pools(), self._params, jnp.asarray(self._tables),
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(active),
+        )
+        self._set_pools(pools)
+        return drafts
+
+    def _spec_verify_device(self, active, drafts, draft_len):
+        *pools, emitted, acc = self._spec.verify(
+            *self._pools(), self._params, jnp.asarray(self._tables),
+            jnp.asarray(self._lengths), jnp.asarray(self._tokens),
+            jnp.asarray(drafts), jnp.asarray(draft_len),
+            jnp.asarray(active), jnp.asarray(self._temps),
+            self._rng, np.int32(self._step_idx),
+        )
+        self._set_pools(pools)
+        return emitted, acc
 
     # ---- observability -----------------------------------------------------
 

@@ -143,6 +143,15 @@ class Request:
     # Paged engines (serving/kvpool): warm prefix-cache blocks this
     # request's block table started from — 0 on a miss or a flat engine.
     prefix_hit_blocks: int = 0
+    # Speculative decoding (serving/spec_decode, §35): drafted /
+    # accepted token counts and aggregate wall time attributed to the
+    # draft vs verify phases (the engine splits each iteration's cost
+    # evenly across its decoding slots; the retrospective spans and the
+    # accept-rate accounting read these).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    draft_s: float = 0.0
+    verify_s: float = 0.0
 
     @property
     def prompt_len(self) -> int:
@@ -166,15 +175,25 @@ class Scheduler:
         token_budget: Optional[int] = None,
         drain_mode: bool = False,
         slo_classes: Optional[Sequence[SloClass]] = None,
+        decode_tokens_per_slot: int = 1,
     ):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if decode_tokens_per_slot < 1:
+            raise ValueError("decode_tokens_per_slot must be >= 1")
         self.slots = slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
+        # Worst-case tokens one decoding slot consumes per iteration:
+        # 1 for plain decode, 1 + spec_k under speculative decoding
+        # (every drafted token is VERIFIED through the model whether or
+        # not it is accepted — the budget must count verification work,
+        # or spec decode would starve prefill at exactly the budgets
+        # tuned for the one-token step).
+        self.decode_tokens_per_slot = decode_tokens_per_slot
         self.token_budget = (
             token_budget if token_budget is not None
-            else prefill_chunk + slots
+            else prefill_chunk + slots * decode_tokens_per_slot
         )
         # drain_mode is the NAIVE static baseline the serving bench A/Bs
         # against: admit a full batch, run it to completion, only then
@@ -421,7 +440,7 @@ class Scheduler:
         ]
         if not cands:
             return None
-        n_decoding = len(self.decoding())
+        n_decoding = len(self.decoding()) * self.decode_tokens_per_slot
         if n_decoding and n_decoding + self.prefill_chunk > self.token_budget:
             return None
         return min(cands, key=lambda r: r.rid)
@@ -462,8 +481,19 @@ class Scheduler:
         req.first_token_ts = None
         req.admit_ts = None
         req.prefix_hit_blocks = 0
+        self._reset_spec_progress(req)
         req.preemptions += 1
         self.queue.appendleft(req)
+
+    @staticmethod
+    def _reset_spec_progress(req: Request) -> None:
+        """Progress resets (preemption, step-error requeue) restart a
+        request from scratch — its speculative accounting restarts with
+        it, or replayed drafts would double-count."""
+        req.spec_drafted = 0
+        req.spec_accepted = 0
+        req.draft_s = 0.0
+        req.verify_s = 0.0
 
     # ---- failure recovery --------------------------------------------------
 
@@ -488,6 +518,7 @@ class Scheduler:
             req.first_token_ts = None
             req.admit_ts = None
             req.prefix_hit_blocks = 0
+            self._reset_spec_progress(req)
             req.requeues += 1
             self.queue.appendleft(req)
         return victims

@@ -84,3 +84,9 @@ def pytest_configure(config):
         "docs/DESIGN.md §34; fast lane runs synthetic-recording "
         "smokes, the record→replay→perturb soak leg is slow-lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "spec: self-speculative decoding (draft/verify/fill-rewind "
+        "over both serving engines, accept-law parity, int8 "
+        "bit-stability) — docs/DESIGN.md §35",
+    )

@@ -160,6 +160,18 @@ def render_block(path: str) -> str:
         ("Ring-attention overlap schedule speedup (s=8192)",
          g("ring_overlap_speedup_s8192"),
          f"{fmt(g('ring_overlap_speedup_s8192'), 3)}x"),
+        # §35 speculative decoding row (absent until a bench round runs
+        # the spec_decode phase): both campaign keys must be present —
+        # tokens/step without the equal-slots serving speedup (or vice
+        # versa) is a partial measurement that must not render.
+        ("Self-spec decode: accepted tokens/verify-step "
+         "(repetitive-suffix workload)",
+         (g("spec_tokens_per_step")
+          if g("spec_serving_speedup") is not None
+          else None),
+         f"{fmt(g('spec_tokens_per_step'))} tok/step"
+         f" / {fmt(g('spec_serving_speedup'))}x serving,"
+         f" equal slots"),
     ]
     origin = (
         "full in-round measurement written by bench.py"

@@ -16,6 +16,12 @@ tracing`` (``DLROVER_TPU_TRACE_FILE``, the fleet soak's
     # cross-checking metrics against traces
     python tools/trace_query.py --verbs spans_master.jsonl
 
+    # serving request lifecycle only: serving.* spans folded into a
+    # per-phase table (queue_wait / prefill / decode, and with
+    # speculative decoding the decode.draft / decode.verify split,
+    # §35) plus each phase's share of total serving.request time
+    python tools/trace_query.py --serving spans_engine.jsonl
+
     # one trace's tree + critical path
     python tools/trace_query.py --trace 7f3a... spans_*.jsonl
 
@@ -69,6 +75,32 @@ def verb_summary(spans: List[Dict]) -> List[Dict]:
         if s.get("name", "").startswith("master.")
         and s.get("kind") == "server"
     ])
+    return rows
+
+
+def serving_summary(spans: List[Dict]) -> List[Dict]:
+    """Per-phase table from the engine's ``serving.*`` request spans
+    (§25/§35): one row per lifecycle phase (``queue_wait``,
+    ``prefill``, ``decode``, and — when speculation ran —
+    ``decode.draft``/``decode.verify``), the ``serving.`` prefix
+    stripped, plus ``share_pct``: that phase's summed duration over
+    the summed ``serving.request`` duration. The draft/verify split is
+    how a speculative deployment answers "where does the step time
+    go" without a profiler attached."""
+    rows = summarize([
+        {**s, "name": s.get("name", "")[len("serving."):]}
+        for s in spans
+        if s.get("name", "").startswith("serving.")
+        and s.get("name") != "serving.request"
+    ])
+    total = sum(
+        s.get("dur_s") or 0.0
+        for s in spans
+        if s.get("name") == "serving.request"
+    )
+    for r in rows:
+        summed = r["mean_s"] * r["count"]
+        r["share_pct"] = round(100.0 * summed / total, 2) if total else 0.0
     return rows
 
 
@@ -155,6 +187,10 @@ def main(argv=None) -> int:
     ap.add_argument("--verbs", action="store_true",
                     help="per-verb latency table from master.<verb> "
                     "server spans (cross-check vs master_rpc_seconds)")
+    ap.add_argument("--serving", action="store_true",
+                    help="per-phase latency table from serving.* "
+                    "request spans (queue/prefill/decode + "
+                    "draft/verify split, with request-time share)")
     ap.add_argument("--trace",
                     help="print one trace's tree + critical path")
     ap.add_argument("--json", action="store_true",
@@ -183,21 +219,34 @@ def main(argv=None) -> int:
             )
         return 0
 
-    if ns.summary or ns.verbs:
-        rows = verb_summary(spans) if ns.verbs else summarize(spans)
+    if ns.summary or ns.verbs or ns.serving:
+        if ns.verbs:
+            rows = verb_summary(spans)
+        elif ns.serving:
+            rows = serving_summary(spans)
+        else:
+            rows = summarize(spans)
         if ns.verbs and not rows:
             print("no master.<verb> server spans found", file=sys.stderr)
+            return 1
+        if ns.serving and not rows:
+            print("no serving.* spans found", file=sys.stderr)
             return 1
         if ns.json:
             print(json.dumps(rows))
             return 0
+        share_hdr = f"{'share%':>8}" if ns.serving else ""
         print(f"{'name':<28}{'count':>7}{'err':>5}{'mean_ms':>10}"
-              f"{'p50_ms':>10}{'p95_ms':>10}{'max_ms':>10}")
+              f"{'p50_ms':>10}{'p95_ms':>10}{'max_ms':>10}{share_hdr}")
         for r in rows:
+            share = (
+                f"{r['share_pct']:>8.2f}" if ns.serving else ""
+            )
             print(
                 f"{r['name']:<28}{r['count']:>7}{r['errors']:>5}"
                 f"{r['mean_s'] * 1e3:>10.3f}{r['p50_s'] * 1e3:>10.3f}"
                 f"{r['p95_s'] * 1e3:>10.3f}{r['max_s'] * 1e3:>10.3f}"
+                f"{share}"
             )
         return 0
 
