@@ -1653,6 +1653,24 @@ def fleet_phase():
     return {f"fleet_{k}": v for k, v in r.items()}
 
 
+def disagg_phase():
+    """Disaggregated prefill/decode serving (tools/bench_disagg.py,
+    §36): the same bimodal long-prompt Poisson schedule through an
+    all-mixed fleet vs a prefill-tier + decode-tier split at equal
+    replica count, with KV-block migration (int8 wire) as the
+    prefill->decode hand-off. Scoreboard: TTFT p99 improvement,
+    tokens/s parity, migration pause ms. Host + CPU-jax subprocesses —
+    runs on every platform."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import bench_disagg
+
+    r = bench_disagg.run_bench()
+    return {f"disagg_{k}": v for k, v in r.items()}
+
+
 def e2e_phase(timeout_s: float = 600.0):
     """Run bench_e2e.py (measured kill->restore->replay through the real
     agent) in subprocesses. Must run BEFORE this process initializes the
@@ -1795,6 +1813,13 @@ _KEEP_KEYS = {
     # serving speedup on shared compiled programs.
     "spec_accept_rate", "spec_tokens_per_step",
     "spec_ms_per_accepted_token_b1", "spec_serving_speedup",
+    # §36 disaggregated serving: the TTFT-tail axis — does splitting
+    # prefill from decode flatten the tail at throughput parity, and
+    # what does the KV-block hand-off pause cost?
+    "disagg_ttft_p99_improvement", "disagg_tokens_per_s_ratio",
+    "disagg_ttft_p99_s", "disagg_coloc_ttft_p99_s",
+    "disagg_itl_p99_improvement", "disagg_tokens_per_s",
+    "disagg_migration_pause_ms_mean", "disagg_migrations",
     "phase_seconds", "peak_rss_mb",
     "prev_round_diff",
 }
@@ -1840,6 +1865,9 @@ _DROP_ORDER = (
     r"|gshard_mfu|dropless_wins)",
     r"^spec_(slots|requests|drafter|drafted|accepted|b1_|retraces"
     r"|token_exact|paged_|tokens_per_s_)",
+    r"^disagg_(replicas|requests|prefill_|decode_|coloc_(tokens|ttft"
+    r"_p50|itl)|ttft_p50|itl_p(50|99)_s|migration_(failures|pause_ms"
+    r"_p50)|completed_frac|retries)",
 )
 
 _TAIL_LIMIT = 1900  # driver tail capture is 2000 chars; stay inside
@@ -2026,6 +2054,10 @@ def main():
         # vs single-engine baseline, plus a kill-mid-run degraded run.
         # Host + CPU subprocesses, every platform.
         run_phase(result, "fleet", fleet_phase, est_s=60, cap_s=240)
+        # Disaggregated prefill/decode split vs co-located at equal
+        # replicas, KV-block migration as the hand-off (§36). Host +
+        # CPU subprocesses, every platform.
+        run_phase(result, "disagg", disagg_phase, est_s=90, cap_s=300)
         # Chaos soak: seeded fault episodes through the whole stack with
         # invariant checks; reports chaos goodput + per-fault MTTR.
         run_phase(
@@ -2149,6 +2181,9 @@ def prev_round_diff(now: dict) -> dict:
         "goodput_attributed_frac",
         "spec_tokens_per_step",
         "spec_serving_speedup",
+        "disagg_ttft_p99_improvement",
+        "disagg_tokens_per_s_ratio",
+        "disagg_migration_pause_ms_mean",
     )
     for path in sorted(files, key=round_no, reverse=True):
         try:

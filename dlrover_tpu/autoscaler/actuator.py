@@ -200,7 +200,10 @@ class FleetActuator:
     last-added-first over the replicas THIS actuator added (a
     grow/shrink pair is a no-op fleet and the original replicas are
     never touched while an added one remains); with none of its own
-    left it falls back to the router's lexicographically-last id."""
+    left it falls back to the router's lexicographically-last id.
+    ``drain_replica`` live-migrates in-flight decodes off the victim
+    (§36) before anything requeues from zero, so a shrink decision
+    costs each in-flight request a migration pause, not a re-prefill."""
 
     def __init__(self, router, replica_factory: Callable[[str], object],
                  id_prefix: str = "as"):

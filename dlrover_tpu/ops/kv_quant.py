@@ -22,7 +22,11 @@ bit-stable across replays); clipping is impossible by construction
 (values are scaled by their own amax).
 """
 
+import json
+import struct
+
 import jax.numpy as jnp
+import numpy as np
 
 # Scales of all-zero rows would be 0 -> 0/0 at dequant; clamp to a
 # denormal-free floor instead (the quantized values are 0 either way).
@@ -58,3 +62,97 @@ def bytes_per_head_row(
     if kv_dtype == "int8":
         return head_dim + 4
     return head_dim * fp_itemsize
+
+
+# ---------------------------------------------------------------------------
+# Pure-bytes wire format (block migration between fleet replicas)
+# ---------------------------------------------------------------------------
+#
+# Layout: MAGIC (4B) | header_len (u32 LE) | json header | kq | vq | ks | vs
+# with kq/vq int8 C-order and ks/vs f32 LE C-order. The header records
+# the int8 payload shape, the scale shape, and the SOURCE cache dtype so
+# the importer knows whether dequantization reconstructs the original
+# cache exactly (int8 source: bit-exact passthrough) or to within the
+# amax/254 quantization bound (fp source: wire cost roughly halves).
+
+_WIRE_MAGIC = b"KVW1"
+
+
+def kv_to_wire(k, v, k_scale=None, v_scale=None):
+    """Pack a (k, v) KV span into a self-describing byte string.
+
+    Floating inputs are int8-quantized here (``quantize_kv``), scales
+    inline; int8 inputs must arrive WITH their scales and pass through
+    bit-exact (the idempotent-roundtrip contract). Shapes are arbitrary
+    ``[..., d]`` as long as k and v match."""
+    k = np.asarray(k)
+    v = np.asarray(v)
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if k.dtype == np.int8:
+        if k_scale is None or v_scale is None:
+            raise ValueError("int8 KV requires k_scale and v_scale")
+        kq, ks = k, np.asarray(k_scale, np.float32)
+        vq, vs = v, np.asarray(v_scale, np.float32)
+        src_dtype = "int8"
+    else:
+        if k_scale is not None or v_scale is not None:
+            raise ValueError("scales only accompany int8 KV")
+        kq, ks = quantize_kv(jnp.asarray(k))
+        vq, vs = quantize_kv(jnp.asarray(v))
+        kq, ks = np.asarray(kq), np.asarray(ks, np.float32)
+        vq, vs = np.asarray(vq), np.asarray(vs, np.float32)
+        src_dtype = str(k.dtype)
+    if ks.shape != kq.shape[:-1] or vs.shape != vq.shape[:-1]:
+        raise ValueError(
+            f"scale shape {ks.shape} does not match KV rows {kq.shape[:-1]}"
+        )
+    header = json.dumps(
+        {
+            "v": 1,
+            "shape": list(kq.shape),
+            "scale_shape": list(ks.shape),
+            "src_dtype": src_dtype,
+        }
+    ).encode()
+    return b"".join(
+        [
+            _WIRE_MAGIC,
+            struct.pack("<I", len(header)),
+            header,
+            np.ascontiguousarray(kq).tobytes(),
+            np.ascontiguousarray(vq).tobytes(),
+            np.ascontiguousarray(ks).tobytes(),
+            np.ascontiguousarray(vs).tobytes(),
+        ]
+    )
+
+
+def kv_from_wire(buf):
+    """Inverse of :func:`kv_to_wire`.
+
+    Returns ``(kq, vq, ks, vs, header)`` — always int8 values + f32
+    scales; the importer dequantizes (``dequantize_kv``) only when its
+    destination cache is fp. ``kv_to_wire(*kv_from_wire(b)[:4])`` is
+    byte-identical to ``b`` (idempotent roundtrip)."""
+    if buf[:4] != _WIRE_MAGIC:
+        raise ValueError("bad KV wire magic")
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    off = 8
+    header = json.loads(buf[off : off + hlen].decode())
+    off += hlen
+    shape = tuple(header["shape"])
+    scale_shape = tuple(header["scale_shape"])
+    n_q = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    n_s = int(np.prod(scale_shape, dtype=np.int64)) if scale_shape else 1
+    want = off + 2 * n_q + 2 * 4 * n_s
+    if len(buf) != want:
+        raise ValueError(f"KV wire truncated: {len(buf)} != {want}")
+    kq = np.frombuffer(buf, np.int8, n_q, off).reshape(shape)
+    off += n_q
+    vq = np.frombuffer(buf, np.int8, n_q, off).reshape(shape)
+    off += n_q
+    ks = np.frombuffer(buf, "<f4", n_s, off).reshape(scale_shape)
+    off += 4 * n_s
+    vs = np.frombuffer(buf, "<f4", n_s, off).reshape(scale_shape)
+    return kq, vq, ks, vs, header

@@ -956,8 +956,30 @@ class ServingEngine:
             "serving.prefill", req.admit_ts, req.first_token_ts,
             parent=root, attrs={"prompt_len": req.prompt_len},
         )
+        decode_start = req.first_token_ts
+        if req.migrate_end_ts is not None:
+            # Migrated request (kvpool/migrate, §36): the migrate
+            # window sits between the source-side prefill and the
+            # local decode. Decode the SOURCE ran before a live-drain
+            # export gets its own contiguous segment so the children
+            # still tile the request end to end (the §29 invariant).
+            m_end = min(req.migrate_end_ts, finish)
+            m_start = req.migrate_start_ts
+            if m_start is None or m_start < req.first_token_ts:
+                m_start = req.first_token_ts
+            m_start = min(m_start, m_end)
+            if m_start - req.first_token_ts > 1e-6:
+                tracer.record_span(
+                    "serving.decode", req.first_token_ts, m_start,
+                    parent=root, attrs={"segment": "pre_migrate"},
+                )
+            tracer.record_span(
+                "serving.migrate", m_start, m_end, parent=root,
+                attrs={"pause_s": round(m_end - m_start, 6)},
+            )
+            decode_start = m_end
         decode_span = tracer.record_span(
-            "serving.decode", req.first_token_ts, finish,
+            "serving.decode", decode_start, finish,
             parent=root, attrs={"new_tokens": len(req.tokens)},
         )
         if req.verify_s > 0.0:
@@ -965,10 +987,10 @@ class ServingEngine:
             # sub-spans (per-slot shares of the iteration wall time,
             # laid contiguously — durations are the signal, not the
             # absolute placement).
-            td = min(req.first_token_ts + req.draft_s, finish)
+            td = min(decode_start + req.draft_s, finish)
             tv = min(td + req.verify_s, finish)
             tracer.record_span(
-                "serving.decode.draft", req.first_token_ts, td,
+                "serving.decode.draft", decode_start, td,
                 parent=decode_span,
                 attrs={"spec_drafted": req.spec_drafted},
             )

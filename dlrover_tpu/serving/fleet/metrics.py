@@ -19,6 +19,10 @@ _LATENCY_BUCKETS = (
 _QUEUE_WAIT_BUCKETS = (
     0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
 )
+_MIGRATION_PAUSE_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 5.0,
+)
 
 
 class FleetMetrics:
@@ -94,6 +98,19 @@ class FleetMetrics:
             "went to the replica holding its prompt prefix's warm KV "
             "blocks instead of the least-loaded choice",
         )
+        self.migrations = reg.counter(
+            "fleet_migrations_total",
+            "KV-block migrations completed (§36): export on the "
+            "source, import acked by the destination, source released",
+        )
+        self.migration_failures = reg.counter(
+            "fleet_migration_failures_total",
+            "migrations that fell back, by reason (no_destination, "
+            "import_send, refused/import error class, timeout) — the "
+            "request still completes exactly once: on the source or "
+            "via one from-scratch re-prefill",
+            labelnames=("reason",),
+        )
         self.queue_depth = reg.gauge(
             "fleet_queue_depth",
             "router requests waiting for a dispatchable replica",
@@ -121,6 +138,12 @@ class FleetMetrics:
             "fleet_queue_wait_seconds",
             "router-submit to first dispatch",
             buckets=_QUEUE_WAIT_BUCKETS,
+        )
+        self.migration_pause = reg.histogram(
+            "fleet_migration_pause_seconds",
+            "export receipt to import ack on the router clock — the "
+            "window a migrating request makes no decode progress",
+            buckets=_MIGRATION_PAUSE_BUCKETS,
         )
 
 

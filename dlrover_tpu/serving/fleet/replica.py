@@ -30,8 +30,25 @@ Every event carries the replica's ``generation`` — a completion from a
 pre-restart generation for an attempt the router already re-routed is
 recognizably stale (the at-most-once key still wins; generations make
 the logs honest).
+
+Disaggregated serving (§36) extends the protocol with three control
+ops (``send()``) and two upstream events, shared by both modes:
+
+- down: ``{"op": "import", "request_id", "attempt", "payload"}``
+  (base64 migration bytes — admit mid-stream via the paged engine's
+  DECODE-entry path), ``{"op": "export", ...}`` (flag an in-flight
+  request for export at its next DECODE boundary — the live-drain
+  trigger), ``{"op": "release", ...}`` (importer acked: drop the
+  source copy, recycle slot + blocks);
+- up: ``{"kind": "exported", "request_id", "attempt", "payload"}``
+  and ``{"kind": "imported", "request_id", "attempt", "ok", ...}``.
+
+A replica whose engine cannot migrate (the flat slot pool) answers an
+import with ``ok: false`` and simply never emits ``exported`` — the
+router falls back to source-side completion, never an error.
 """
 
+import base64
 import json
 import os
 import subprocess
@@ -40,7 +57,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Set
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
@@ -71,6 +88,11 @@ class WorkItem:
     # "span_id"} or None): the replica engine parents its phase spans
     # to it, so a rerouted request is one tree across processes.
     trace: Optional[dict] = None
+    # Two-phase dispatch (§36): export this request's KV blocks as
+    # soon as prefill completes (first token sampled) — the replica
+    # emits an ``exported`` event and KEEPS the request live until the
+    # router's ``release`` op (the importer's ack).
+    migrate_after_prefill: bool = False
 
     def to_wire(self) -> dict:
         return {
@@ -83,6 +105,7 @@ class WorkItem:
             "deadline_s": self.deadline_s,
             "slo_class": self.slo_class,
             "trace": self.trace,
+            "migrate_after_prefill": self.migrate_after_prefill,
         }
 
 
@@ -109,12 +132,13 @@ def _completion(item_key, ok, tokens, truncated, failure_reason,
 
 def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
                  max_new_tokens, temperature, deadline_s,
-                 trace=None, slo_class=None) -> None:
+                 trace=None, slo_class=None):
     """One work item into the engine — shared by both replica modes so
     the wire behavior cannot drift. A scheduler rejection (prompt too
     long, bad deadline, unknown SLO class) is an EXPLICIT failed
     completion, never a crash: crashing here would cascade the poison
-    request through the fleet."""
+    request through the fleet. Returns the engine request (None on
+    rejection) so callers can flag it for post-prefill export."""
     try:
         req = engine.submit(
             prompt, max_new_tokens,
@@ -127,8 +151,9 @@ def serve_submit(engine, by_rid, emit, request_id, attempt, prompt,
             ok=False, tokens=(), truncated=False,
             failure_reason="rejected", ttft_s=None,
         ))
-    else:
-        by_rid[req.rid] = (request_id, attempt)
+        return None
+    by_rid[req.rid] = (request_id, attempt)
+    return req
 
 
 def serve_step(engine, by_rid, emit) -> None:
@@ -146,6 +171,121 @@ def serve_step(engine, by_rid, emit) -> None:
             failure_reason=req.failure_reason,
             ttft_s=req.ttft_s,
         ))
+
+
+def serve_exports(engine, by_rid, emit, migrate_rids: Set[int]) -> None:
+    """Export every flagged request that has reached DECODE (first
+    token sampled): emit an ``exported`` event with the base64
+    migration payload and KEEP the request live — the router decides
+    between a destination import (followed by ``release``) and
+    source-side completion. Shared by both replica modes. A flat
+    engine (no block plane) simply unflags: the fallback is serving
+    the decode locally, never an error."""
+    if not migrate_rids:
+        return
+    for rid in list(migrate_rids):
+        if rid not in by_rid:
+            migrate_rids.discard(rid)  # finished before export fired
+    if not migrate_rids:
+        return
+    from dlrover_tpu.serving.kvpool.migrate import export_request
+    from dlrover_tpu.serving.scheduler import DECODE
+    for req in list(getattr(engine.scheduler, "by_slot", ())):
+        if req is None or req.rid not in migrate_rids:
+            continue
+        if req.state != DECODE or not req.tokens:
+            continue  # still prefilling; try again next iteration
+        migrate_rids.discard(req.rid)
+        key = by_rid.get(req.rid)
+        if key is None:
+            continue
+        try:
+            payload = export_request(engine, req)
+        except Exception as e:  # noqa: BLE001 — flat engine / torn
+            # state: the local decode continues; the explicit error
+            # event lets a draining router stop waiting for this key.
+            logger.debug("export of rid %d failed", req.rid,
+                         exc_info=True)
+            emit({
+                "kind": "exported",
+                "request_id": key[0],
+                "attempt": key[1],
+                "error": type(e).__name__,
+            })
+            continue
+        emit({
+            "kind": "exported",
+            "request_id": key[0],
+            "attempt": key[1],
+            "payload": base64.b64encode(payload).decode("ascii"),
+        })
+
+
+def serve_import(engine, by_rid, emit, cmd: dict) -> None:
+    """Admit a migrated payload mid-stream (DECODE entry). Any failure
+    — full destination, flat engine, malformed bytes — is an explicit
+    ``ok: false`` ack, never a crash: the source still owns the
+    request and completes it locally."""
+    request_id = cmd["request_id"]
+    attempt = cmd.get("attempt", 0)
+    try:
+        from dlrover_tpu.serving.kvpool.migrate import import_request
+
+        payload = base64.b64decode(cmd["payload"])
+        req = import_request(engine, payload, trace=cmd.get("trace"))
+    except Exception as e:  # noqa: BLE001 — refusal IS the protocol
+        emit({
+            "kind": "imported", "request_id": request_id,
+            "attempt": attempt, "ok": False,
+            "reason": type(e).__name__,
+        })
+        return
+    by_rid[req.rid] = (request_id, attempt)
+    emit({
+        "kind": "imported", "request_id": request_id,
+        "attempt": attempt, "ok": True,
+    })
+
+
+def serve_release(engine, by_rid, cmd: dict) -> None:
+    """The importer acked: drop the source copy (slot + blocks
+    recycled, ``migrated`` outcome). A request that already finished
+    locally (the source won the race) is a no-op — its completion is
+    the router's at-most-once duplicate."""
+    key = (cmd["request_id"], cmd.get("attempt", 0))
+    rid = next((r for r, k in by_rid.items() if k == key), None)
+    if rid is None:
+        return
+    by_rid.pop(rid, None)
+    req = next(
+        (q for q in getattr(engine.scheduler, "by_slot", ())
+         if q is not None and q.rid == rid),
+        None,
+    )
+    if req is not None:
+        from dlrover_tpu.serving.kvpool.migrate import release_exported
+
+        release_exported(engine, req)
+
+
+def serve_control(engine, by_rid, emit, migrate_rids: Set[int],
+                  cmd: dict) -> None:
+    """Dispatch one §36 control op — shared by both replica modes."""
+    op = cmd.get("op")
+    if op == "import":
+        serve_import(engine, by_rid, emit, cmd)
+    elif op == "release":
+        serve_release(engine, by_rid, cmd)
+    elif op == "export":
+        # Live drain: flag an in-flight request; serve_exports fires
+        # at its next DECODE boundary (or immediately if already
+        # decoding). Unknown key = already finished: nothing to do.
+        key = (cmd["request_id"], cmd.get("attempt", 0))
+        rid = next(
+            (r for r, k in by_rid.items() if k == key), None
+        )
+        if rid is not None:
+            migrate_rids.add(rid)
 
 
 class ThreadReplica:
@@ -166,8 +306,10 @@ class ThreadReplica:
         engine_factory: Callable[[], object],
         clock: Callable[[], float] = time.monotonic,
         idle_sleep_s: float = 0.001,
+        role: str = "mixed",
     ):
         self.replica_id = str(replica_id)
+        self.role = role  # §36: "prefill" | "decode" | "mixed"
         self._engine_factory = engine_factory
         self._clock = clock
         self._idle_sleep_s = idle_sleep_s
@@ -235,6 +377,16 @@ class ThreadReplica:
         with self._lock:
             self._inbox.append(item)
 
+    def send(self, payload: dict) -> None:
+        """A §36 control op (import / export / release) into the
+        mailbox — the in-process twin of the subprocess JSONL line."""
+        if not self.alive():
+            raise ReplicaDeadError(
+                f"replica {self.replica_id} is not running"
+            )
+        with self._lock:
+            self._inbox.append(dict(payload))
+
     def poll(self) -> List[dict]:
         out = []
         while True:
@@ -260,6 +412,7 @@ class ThreadReplica:
         self._ready.set()
         self._hb = self._clock()
         by_rid: Dict[int, tuple] = {}   # engine rid -> (request_id, attempt)
+        migrate_rids: Set[int] = set()  # flagged for post-prefill export
 
         def emit(event: dict) -> None:
             event["generation"] = generation
@@ -291,17 +444,25 @@ class ThreadReplica:
                     )
                 if item is None:
                     break
-                serve_submit(
-                    engine, by_rid, emit,
-                    item.request_id, item.attempt, item.prompt,
-                    item.max_new_tokens, item.temperature,
-                    item.deadline_s, trace=item.trace,
-                    slo_class=item.slo_class,
-                )
+                if isinstance(item, WorkItem):
+                    req = serve_submit(
+                        engine, by_rid, emit,
+                        item.request_id, item.attempt, item.prompt,
+                        item.max_new_tokens, item.temperature,
+                        item.deadline_s, trace=item.trace,
+                        slo_class=item.slo_class,
+                    )
+                    if req is not None and item.migrate_after_prefill:
+                        migrate_rids.add(req.rid)
+                else:
+                    serve_control(
+                        engine, by_rid, emit, migrate_rids, item
+                    )
                 moved = True
             if engine.pending():
                 serve_step(engine, by_rid, emit)
                 moved = True
+            serve_exports(engine, by_rid, emit, migrate_rids)
             if not moved:
                 time.sleep(self._idle_sleep_s)
 
@@ -322,11 +483,13 @@ class SubprocessReplica:
         prefill_chunk: int = 8,
         heartbeat_s: float = 0.2,
         step_delay_ms: float = 0.0,
+        token_delay_us: float = 0.0,
         schedule_path="",
         clock: Callable[[], float] = time.monotonic,
         paged: bool = False,
         block_size: int = 8,
         num_blocks: Optional[int] = None,
+        role: str = "mixed",
     ):
         # ``schedule_path``: a str arms the same fault schedule on every
         # generation; a sequence indexes by generation ("" past the end)
@@ -334,12 +497,14 @@ class SubprocessReplica:
         # schedule comes back CLEAN and can actually recover instead of
         # deterministically re-dying at the same hit count forever.
         self.replica_id = str(replica_id)
+        self.role = role  # §36: "prefill" | "decode" | "mixed"
         self._work_dir = work_dir
         self._slots = slots
         self._max_len = max_len
         self._prefill_chunk = prefill_chunk
         self._heartbeat_s = heartbeat_s
         self._step_delay_ms = step_delay_ms
+        self._token_delay_us = token_delay_us
         self._schedule_path = schedule_path
         self._clock = clock
         self._paged = paged
@@ -415,6 +580,8 @@ class SubprocessReplica:
             "--heartbeat-s", str(self._heartbeat_s),
             "--step-delay-ms", str(self._step_delay_ms),
         ]
+        if self._token_delay_us > 0:
+            args += ["--token-delay-us", str(self._token_delay_us)]
         if self._paged:
             args += ["--paged", "--block-size", str(self._block_size)]
             if self._num_blocks is not None:
@@ -477,6 +644,10 @@ class SubprocessReplica:
     def submit(self, item: WorkItem) -> None:
         self._send(item.to_wire())
 
+    def send(self, payload: dict) -> None:
+        """A §36 control op as a JSONL line (the ThreadReplica twin)."""
+        self._send(payload)
+
     def poll(self) -> List[dict]:
         out = []
         while True:
@@ -530,7 +701,7 @@ class SubprocessReplica:
                 elif kind == "ready":
                     self._hb = self._clock()
                     self._ready.set()
-                elif kind == "done":
+                elif kind in ("done", "exported", "imported"):
                     event.setdefault("generation", generation)
                     self._hb = self._clock()
                     self._outbox.append(event)

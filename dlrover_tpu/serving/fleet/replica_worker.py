@@ -11,10 +11,13 @@ Protocol (one JSON object per line):
 
 - stdin:  ``{"op": "submit", "request_id", "attempt", "prompt",
   "max_new_tokens", "temperature", "deadline_s"}`` | ``{"op": "stop"}``
+  | the §36 migration ops ``import`` / ``export`` / ``release``
+  (see :mod:`dlrover_tpu.serving.fleet.replica`)
 - stdout: ``{"kind": "ready"}`` once warm, ``{"kind": "heartbeat"}``
-  every ``--heartbeat-s`` while serving, and one ``{"kind": "done",
+  every ``--heartbeat-s`` while serving, one ``{"kind": "done",
   ...}`` completion per accepted (request, attempt) — ok, explicitly
-  failed, or shed; never silence.
+  failed, or shed; never silence — plus ``exported`` / ``imported``
+  migration events when serving the paged engine.
 
 The model is the deterministic tiny llama (seed 0), so every replica in
 a fleet serves identical weights and a re-routed greedy request decodes
@@ -33,6 +36,28 @@ import time
 def _emit(obj: dict) -> None:
     sys.stdout.write(json.dumps(obj) + "\n")
     sys.stdout.flush()
+
+
+def _chunk_tokens(engine, prefill_chunk: int) -> int:
+    """Prompt tokens the next engine iteration will prefill: the
+    FCFS-picked PREFILL slot's next chunk (0 when nothing is
+    prefilling) — mirrors the scheduler's one-chunk-per-iteration
+    policy, including the short FINAL chunk of a prompt (charging the
+    full chunk width for an 8-token tail would tax big-chunk prefill
+    tiers for tokens they never compute). Drives the --token-delay-us
+    service-time simulation; the decode batch is deliberately NOT
+    counted — see the --token-delay-us help for the roofline model."""
+    sched = getattr(engine, "scheduler", None)
+    by_slot = getattr(sched, "by_slot", None) if sched else None
+    if not by_slot:
+        return 0
+    prefilling = [
+        r for r in by_slot if r is not None and r.state == "prefill"
+    ]
+    if not prefilling:
+        return 0
+    nxt = min(prefilling, key=lambda r: r.rid)
+    return max(min(prefill_chunk, nxt.prompt_len - nxt.prefill_pos), 0)
 
 
 def _read_commands(q: "queue.Queue[dict]") -> None:
@@ -64,7 +89,24 @@ def main(argv=None) -> int:
         help="simulated accelerator milliseconds per engine iteration "
         "(the soak-worker --step-ms idiom): sleeping releases the "
         "host CPU, so a fleet bench on a small host measures the "
-        "router/host plane, not the tiny model's CPU decode",
+        "router/host plane, not the tiny model's CPU decode. In the "
+        "roofline service-time model this is the memory-bound term — "
+        "the weight/KV read every iteration pays once, which the "
+        "whole decode batch rides for free",
+    )
+    parser.add_argument(
+        "--token-delay-us", type=float, default=0.0,
+        help="simulated accelerator microseconds per PREFILL token in "
+        "the iteration's prompt chunk — the compute-bound roofline "
+        "term. Decode is memory-bound (batch amortizes the flat "
+        "--step-delay-ms read), prefill is compute-bound (cost grows "
+        "with chunk tokens): a mixed replica's chunked iteration "
+        "therefore stretches every co-resident decoder's inter-token "
+        "latency by the chunk's compute, and a replica that chunks at "
+        "4 pays the flat read per 4 prompt tokens while a dedicated "
+        "prefill tier chunking at 16 pays it per 16 — the two "
+        "interference asymmetries disaggregation (§36) exists to "
+        "split apart",
     )
     parser.add_argument(
         "--paged", action="store_true",
@@ -103,7 +145,12 @@ def main(argv=None) -> int:
 
     from dlrover_tpu.models import llama
     from dlrover_tpu.serving.engine import ServingEngine
-    from dlrover_tpu.serving.fleet.replica import serve_step, serve_submit
+    from dlrover_tpu.serving.fleet.replica import (
+        serve_control,
+        serve_exports,
+        serve_step,
+        serve_submit,
+    )
 
     cfg = llama.tiny_config()
     params, _ = llama.init_params(cfg, jax.random.key(0))
@@ -139,6 +186,7 @@ def main(argv=None) -> int:
     _emit({"kind": "ready", "replica": args.replica_id,
            "pid": os.getpid()})
     by_rid = {}  # engine rid -> (request_id, attempt)
+    migrate_rids = set()  # engine rids flagged for post-prefill export
     last_hb = 0.0
     while True:
         now = time.monotonic()
@@ -168,7 +216,7 @@ def main(argv=None) -> int:
             if cmd.get("op") == "stop":
                 return 0
             if cmd.get("op") == "submit":
-                serve_submit(
+                req = serve_submit(
                     engine, by_rid, _emit,
                     cmd["request_id"], cmd.get("attempt", 0),
                     cmd["prompt"], cmd["max_new_tokens"],
@@ -176,14 +224,35 @@ def main(argv=None) -> int:
                     trace=cmd.get("trace"),
                     slo_class=cmd.get("slo_class"),
                 )
+                if req is not None and cmd.get("migrate_after_prefill"):
+                    migrate_rids.add(req.rid)
+            elif cmd.get("op") in ("import", "export", "release"):
+                if cmd["op"] == "import":
+                    # The kill_during_migration chaos window: the
+                    # payload has left the source (export done) and no
+                    # import ack has been emitted — a ``crash`` rule
+                    # here SIGKILLs the destination holding the bytes.
+                    # The source was never released, so it must still
+                    # complete the request exactly once with zero
+                    # blocks lost on either end.
+                    fault_point(
+                        "fleet.replica.import", replica=args.replica_id
+                    )
+                serve_control(engine, by_rid, _emit, migrate_rids, cmd)
         if engine.pending():
             # The chaos episode's SIGKILL-mid-decode lands here: a
             # ``crash`` rule on fleet.replica.step fires between two
             # engine iterations with requests live in slots.
             fault_point("fleet.replica.step", replica=args.replica_id)
-            if args.step_delay_ms > 0:
-                time.sleep(args.step_delay_ms / 1000.0)
+            delay = args.step_delay_ms / 1000.0
+            if args.token_delay_us > 0:
+                delay += args.token_delay_us * _chunk_tokens(
+                    engine, args.prefill_chunk
+                ) / 1e6
+            if delay > 0:
+                time.sleep(delay)
             serve_step(engine, by_rid, _emit)
+        serve_exports(engine, by_rid, _emit, migrate_rids)
 
 
 if __name__ == "__main__":

@@ -36,6 +36,20 @@ explicitly failed exactly once**. The pieces:
   victims re-queue at the FRONT in submit order and re-dispatch
   elsewhere. The router restarts dead replicas after the breaker's
   cooldown and re-admits them through half-open probes.
+- **Disaggregated prefill/decode (§36).** Replica handles carry a
+  ``role`` (``prefill`` | ``decode`` | ``mixed``; all-mixed = the
+  co-located baseline, byte-for-byte unchanged). Fresh work lands on
+  prefill-capable replicas; a request dispatched to a ``prefill``
+  replica is flagged ``migrate_after_prefill`` — when its first token
+  lands, the replica exports the KV blocks, the router hands them to
+  the least-loaded decode-capable replica, and on the import ack moves
+  the in-flight ledger entry and releases the source. Every failure
+  mode falls back without breaking exactly-once: a refused/failed
+  import means the source (still live — it keeps decoding until the
+  release ack) completes the request; a destination that dies after
+  the ack is the ordinary crash-re-route, one from-scratch re-prefill.
+  ``drain_replica`` uses the same machinery to move in-flight decodes
+  OFF a shrinking replica instead of requeueing them from zero.
 
 The router is pump-driven by design: every structure is owned by the
 pump (``step()``), driven by the caller or by ``serve_forever``-style
@@ -105,6 +119,13 @@ class RouterConfig:
     # Affinity yields to load balance when the warm replica is this
     # many in-flight items busier than the least-loaded candidate.
     affinity_max_load_gap: int = 4
+    # §36: an exported payload whose import ack never arrives
+    # (destination SIGKILLed mid-migration) is forgotten after this
+    # long — the source, still live, completes the request.
+    migration_timeout_s: float = 30.0
+    # Live drain (§36): how long drain_replica pumps for in-flight
+    # decodes to migrate off before falling back to requeue-from-zero.
+    drain_migrate_timeout_s: float = 10.0
     health: health_lib.HealthPolicy = field(
         default_factory=health_lib.HealthPolicy
     )
@@ -162,6 +183,21 @@ class FleetRequest:
         return self.result is not None
 
 
+@dataclass
+class _Migration:
+    """One in-flight §36 migration: export received from ``src``,
+    import sent to ``dst``, awaiting the ack. The source keeps the
+    request live the whole time — a lost ack costs nothing but the
+    wasted wire bytes."""
+
+    req: FleetRequest
+    attempt: int
+    src: str
+    dst: str
+    export_t: float
+    span: Optional[object] = None
+
+
 class FleetRouter:
     """See module docstring. One pump thread drives ``step()``; the
     live-sizing surface (``add_replica``/``drain_replica``, the §30
@@ -217,6 +253,14 @@ class FleetRouter:
         self._affinity: "OrderedDict[int, str]" = OrderedDict()
         self._last_restart: Dict[str, float] = {}
         self._service_lat: Deque[float] = deque(maxlen=256)
+        # §36: (request_id, attempt) -> in-flight migration awaiting
+        # its import ack; replicas being drained (no new dispatches,
+        # no migration destinations, no auto-restart).
+        self._migrations: Dict[Tuple[str, int], _Migration] = {}
+        self._draining: set = set()
+        # Keys whose live-drain export failed (flat engine): the drain
+        # loop stops waiting on them and falls back to requeue.
+        self._export_failed: set = set()
         self._rng = random.Random(self.config.seed)
         self._seq = 0
 
@@ -307,13 +351,20 @@ class FleetRouter:
             logger.info("fleet replica %s added (%d replicas)",
                         rid, len(self._replicas))
 
-    def drain_replica(self, replica_id, stop: bool = True) -> bool:
-        """Shrink the fleet live: reclaim the replica's in-flight
-        ledger back onto the queue (the crash-re-route path, so nothing
-        is lost or duplicated), drop it from dispatch, and stop it.
-        Refuses to drain the last replica — a fleet of zero is an
-        outage, not a scale decision."""
+    def drain_replica(self, replica_id, stop: bool = True,
+                      migrate: bool = True) -> bool:
+        """Shrink the fleet live. With ``migrate`` (§36, the default)
+        in-flight decodes are first MIGRATED off — each one keeps its
+        sampled tokens and filled blocks instead of re-prefilling from
+        zero; whatever cannot migrate within
+        ``drain_migrate_timeout_s`` (mid-prefill, flat engine, no
+        destination) falls back to the crash-re-route path, so nothing
+        is lost or duplicated either way. The replica is fenced from
+        new dispatches and destinations for the whole drain. Refuses
+        to drain the last replica — a fleet of zero is an outage, not
+        a scale decision."""
         rid = str(replica_id)
+        migrating = False
         with self._lock:
             if rid not in self._replicas:
                 return False
@@ -321,8 +372,49 @@ class FleetRouter:
                 raise ValueError(
                     "refusing to drain the last fleet replica"
                 )
+            self._draining.add(rid)
+            replica = self._replicas[rid]
+            if migrate and self._ledger[rid] and replica.alive():
+                for request_id, attempt in list(self._ledger[rid]):
+                    try:
+                        replica.send({
+                            "op": "export",
+                            "request_id": request_id,
+                            "attempt": attempt,
+                        })
+                        migrating = True
+                    except Exception:  # noqa: BLE001 — no send()
+                        # surface / dead pipe: requeue-from-zero below.
+                        break
+        if migrating:
+            # Pump OUTSIDE the lock until every in-flight key either
+            # migrated away (ledger entry moved to its destination),
+            # finished, or declared itself unexportable.
+            deadline = (
+                time.monotonic() + self.config.drain_migrate_timeout_s
+            )
+            while time.monotonic() < deadline:
+                self.step()
+                with self._lock:
+                    waiting = any(
+                        k not in self._export_failed
+                        for k in self._ledger.get(rid, {})
+                    ) or any(
+                        m.src == rid
+                        for m in self._migrations.values()
+                    )
+                if not waiting:
+                    break
+                time.sleep(0.002)
+        with self._lock:
+            self._export_failed.clear()
+            self._draining.discard(rid)
+            if rid not in self._replicas:
+                return False  # lost a drain race
             now = self._clock()
             newly_done: List[FleetRequest] = []
+            # Whatever still sits on the replica re-queues from zero —
+            # the crash-re-route path.
             self._reclaim(rid, now, newly_done)
             # Terminal results produced by the reclaim surface from the
             # next step(), not silently only in results().
@@ -331,6 +423,7 @@ class FleetRouter:
             self._health.pop(rid, None)
             self._ledger.pop(rid, None)
             self._last_restart.pop(rid, None)
+            self._purge_affinity(rid)
             remaining = len(self._replicas)
         if stop:
             try:
@@ -451,6 +544,7 @@ class FleetRouter:
         now = self._clock()
         self._promote_waiting(now)
         self._shed_expired(now, newly_done)
+        self._prune_migrations(now)
         self._dispatch_queued(now, newly_done)
         if self.config.hedge_enabled:
             self._hedge_sweep(now, newly_done)
@@ -508,12 +602,16 @@ class FleetRouter:
     # ---- completions -------------------------------------------------------
 
     def _drain_replicas(self, now: float, newly_done: List[FleetRequest]):
-        for rid, replica in self._replicas.items():
+        for rid, replica in list(self._replicas.items()):
             self._health[rid].observe_heartbeat(replica.last_heartbeat())
             for event in replica.poll():
-                if event.get("kind") != "done":
-                    continue
-                self._handle_completion(rid, event, now, newly_done)
+                kind = event.get("kind")
+                if kind == "done":
+                    self._handle_completion(rid, event, now, newly_done)
+                elif kind == "exported":
+                    self._handle_exported(rid, event, now)
+                elif kind == "imported":
+                    self._handle_imported(rid, event, now)
 
     def _handle_completion(self, rid: str, event: dict, now: float,
                            newly_done: List[FleetRequest]):
@@ -624,6 +722,183 @@ class FleetRouter:
         while len(self._done_order) > self.config.max_done_retained:
             self._requests.pop(self._done_order.popleft(), None)
 
+    # ---- §36 migration (two-phase dispatch / live drain) -------------------
+
+    def _role(self, rid: str) -> str:
+        replica = self._replicas.get(rid)
+        return getattr(replica, "role", "mixed") if replica else "mixed"
+
+    def _send_release(self, rid: str, key: Tuple[str, int]) -> None:
+        """Ack the source: drop its copy. Best-effort — a dead source
+        frees everything at exit anyway."""
+        replica = self._replicas.get(rid)
+        if replica is None:
+            return
+        try:
+            replica.send({
+                "op": "release",
+                "request_id": key[0], "attempt": key[1],
+            })
+        except Exception:  # noqa: BLE001 — dead pipe = moot release
+            pass
+
+    def _pick_decode_replica(self, now: float,
+                             exclude=()) -> Optional[str]:
+        """Least-loaded decode-capable destination for a migration:
+        ``decode`` before ``mixed`` (a dedicated decode replica is the
+        point of the topology), HEALTHY before SUSPECT before
+        HALF_OPEN, then load. Never the source, never a draining
+        replica."""
+        rank = {
+            health_lib.HEALTHY: 0,
+            health_lib.SUSPECT: 1,
+            health_lib.HALF_OPEN: 2,
+        }
+        cands = []
+        for rid, replica in self._replicas.items():
+            if rid in exclude or rid in self._draining:
+                continue
+            if self._role(rid) not in ("decode", "mixed"):
+                continue
+            if not replica.alive() or not replica.wait_ready(0.0):
+                continue
+            h = self._health[rid]
+            if not h.dispatchable(now):
+                continue
+            cands.append((
+                0 if self._role(rid) == "decode" else 1,
+                rank[h.state], len(self._ledger[rid]), rid,
+            ))
+        if not cands:
+            return None
+        cands.sort()
+        return cands[0][3]
+
+    def _handle_exported(self, src: str, event: dict, now: float):
+        """A source replica exported a flagged request's KV blocks:
+        pick a decode destination and forward the payload. No viable
+        destination (or a dead pipe) is a FALLBACK, not a failure —
+        the source still owns the request and completes it."""
+        key = (event.get("request_id"), event.get("attempt", 0))
+        req = self._requests.get(key[0])
+        if not event.get("payload"):
+            # The source could not serialize (flat engine, torn
+            # state): it keeps decoding; a drain stops waiting.
+            self._export_failed.add(key)
+            self.metrics.migration_failures.inc(reason="export_failed")
+            return
+        if req is None or req.done:
+            # Finished/expired while the export was in flight: the
+            # source copy is surplus.
+            self._send_release(src, key)
+            return
+        if key in self._migrations:
+            return  # duplicate export event (restarted source replays)
+        dst = self._pick_decode_replica(now, exclude={src})
+        if dst is None:
+            self.metrics.migration_failures.inc(reason="no_destination")
+            return
+        mspan = None
+        tracer = tracing.active_tracer()
+        if tracer is not None and req.span is not None:
+            mspan = tracer.start_span(
+                "fleet.migrate", kind="client", parent=req.span,
+                attrs={"src": src, "dst": dst},
+            )
+        try:
+            fault_point(
+                "fleet.router.migrate",
+                src=src, dst=dst, request=key[0],
+            )
+            self._replicas[dst].send({
+                "op": "import",
+                "request_id": key[0], "attempt": key[1],
+                "payload": event["payload"],
+            })
+        except Exception as e:  # noqa: BLE001 — dead pipe / injected
+            if mspan is not None:
+                mspan.set_attr("failure_reason", "import_send")
+                mspan.end(status="error")
+            self._health[dst].record_failure(
+                f"migrate_send:{type(e).__name__}"
+            )
+            self.metrics.migration_failures.inc(reason="import_send")
+            return
+        self._migrations[key] = _Migration(
+            req=req, attempt=key[1], src=src, dst=dst,
+            export_t=now, span=mspan,
+        )
+
+    def _handle_imported(self, dst: str, event: dict, now: float):
+        """The destination acked an import. ok: move the in-flight
+        ledger entry src -> dst, release the source, count the pause.
+        not-ok (full destination, flat engine, torn payload): the
+        source keeps the request — completion still happens exactly
+        once, just co-located."""
+        key = (event.get("request_id"), event.get("attempt", 0))
+        mig = self._migrations.pop(key, None)
+        if mig is None or mig.dst != dst:
+            return  # timed-out / already-resolved migration: stale ack
+        req = mig.req
+        if not event.get("ok"):
+            reason = event.get("reason") or "import_failed"
+            self.metrics.migration_failures.inc(reason=reason)
+            if mig.span is not None:
+                mig.span.set_attr("failure_reason", reason)
+                mig.span.end(status="error")
+            # A refusal is the destination WORKING (it is full, or not
+            # paged) — no breaker strike; the source decodes on.
+            return
+        pause = max(0.0, now - mig.export_t)
+        self.metrics.migrations.inc()
+        self.metrics.migration_pause.observe(pause)
+        self._health[dst].record_success()
+        if mig.span is not None:
+            mig.span.set_attr("pause_s", round(pause, 6))
+            mig.span.end()
+        if req.done:
+            # The source finished (or the deadline fired) during the
+            # handshake: the destination's copy will complete as a
+            # counted duplicate; nothing to move.
+            self._send_release(mig.src, key)
+            return
+        entry = self._ledger.get(mig.src, {}).pop(key, None)
+        if entry is not None and dst in self._ledger:
+            self._ledger[dst][key] = req
+            live = req.live_attempts.get(mig.attempt)
+            if live is not None:
+                if live[2]:
+                    # The probe resolved: the source survived an
+                    # end-to-end prefill + export.
+                    self._health[mig.src].end_probe()
+                    self._health[mig.src].record_success()
+                req.live_attempts[mig.attempt] = (dst, live[1], False)
+            aspan = req.attempt_spans.get(mig.attempt)
+            if aspan is not None:
+                aspan.set_attr("migrated_to", dst)
+        self._send_release(mig.src, key)
+
+    def _prune_migrations(self, now: float):
+        """Forget migrations whose ack never came (destination died
+        between export and import-ack — the chaos episode). The source
+        never released, so the request completes there; zero blocks
+        are lost on either end."""
+        if not self._migrations:
+            return
+        expired = [
+            k for k, m in self._migrations.items()
+            if now - m.export_t > self.config.migration_timeout_s
+            or m.req.done
+        ]
+        for k in expired:
+            mig = self._migrations.pop(k)
+            reason = "abandoned" if mig.req.done else "timeout"
+            if not mig.req.done:
+                self.metrics.migration_failures.inc(reason="timeout")
+            if mig.span is not None:
+                mig.span.set_attr("failure_reason", reason)
+                mig.span.end(status="error")
+
     # ---- failure / retry ---------------------------------------------------
 
     def _attempt_failed(self, req: FleetRequest, reason: str, now: float,
@@ -673,6 +948,17 @@ class FleetRouter:
 
     # ---- health / reclaim --------------------------------------------------
 
+    def _purge_affinity(self, rid: str) -> None:
+        """Drop every affinity entry pointing at ``rid`` — its warm
+        blocks are gone (drained, crashed, restarted into a cold
+        cache). Lazy lapse-on-lookup alone leaves a bounded-LRU slot
+        wasted per stale entry AND, worse, keeps steering same-prefix
+        requests through a pointless miss path; the eager purge keeps
+        the map honest at the moment the blocks die."""
+        stale = [k for k, v in self._affinity.items() if v == rid]
+        for k in stale:
+            self._affinity.pop(k, None)
+
     def _make_transition_hook(self, rid: str):
         def hook(old: str, new: str):
             self.metrics.replica_state.set(
@@ -711,6 +997,7 @@ class FleetRouter:
             )
             if (
                 self.config.auto_restart
+                and rid not in self._draining
                 and (not replica.alive() or wedged)
                 and h.cooldown_elapsed(now)
                 # BROKEN keeps its original _broken_since across a
@@ -727,6 +1014,9 @@ class FleetRouter:
                 replica.restart()
                 self._last_restart[rid] = now
                 self.metrics.restarts.inc()
+                # The respawn boots with a cold block cache: affinity
+                # entries naming it steer nothing warm anymore.
+                self._purge_affinity(rid)
                 # Grace: strikes resume from the restart, and the
                 # HALF_OPEN flip happens at the next dispatch attempt.
                 h.observe_heartbeat(now)
@@ -738,6 +1028,7 @@ class FleetRouter:
         order."""
         entries = list(self._ledger[rid].items())
         self._ledger[rid].clear()
+        self._purge_affinity(rid)
         victims: List[FleetRequest] = []
         for (request_id, attempt), req in entries:
             if req.done:
@@ -813,10 +1104,18 @@ class FleetRouter:
             health_lib.HALF_OPEN: 2,
         }
 
-        def candidates(excluded):
+        def candidates(excluded, allow_decode_role=False):
             cands = []
             for rid in self._replicas:
-                if rid in excluded:
+                if rid in excluded or rid in self._draining:
+                    continue
+                if (
+                    not allow_decode_role
+                    and self._role(rid) == "decode"
+                ):
+                    # §36: dedicated decode replicas take work only
+                    # through migration imports — a fresh prompt there
+                    # would burn their decode slots on prefill.
                     continue
                 if not self._replicas[rid].alive():
                     # Checked BEFORE dispatchable(): a cooled-down dead
@@ -839,6 +1138,14 @@ class FleetRouter:
             # tried one beats stalling forever.
             cands = candidates(set())
         if not cands:
+            # Availability beats role purity: a fleet whose every
+            # prefill-capable replica is down still serves from the
+            # decode pool rather than stalling the queue.
+            cands = candidates(
+                set() if not strict_exclude else set(exclude),
+                allow_decode_role=True,
+            )
+        if not cands:
             return None
         cands.sort()
         return cands[0][2]
@@ -851,6 +1158,11 @@ class FleetRouter:
         for rid, replica in self._replicas.items():
             h = self._health[rid]
             if h.state not in (health_lib.BROKEN, health_lib.HALF_OPEN):
+                continue
+            if rid in self._draining or self._role(rid) == "decode":
+                # Decode-role replicas are probed by migration traffic
+                # (the import ack records their success), not by fresh
+                # prompts.
                 continue
             if not replica.alive():
                 continue
@@ -884,6 +1196,8 @@ class FleetRouter:
             return None
         if (
             rid in req.tried_replicas
+            or rid in self._draining
+            or self._role(rid) == "decode"
             or not replica.alive()
             or not replica.wait_ready(0.0)
             or not self._health[rid].dispatchable(now)
@@ -949,6 +1263,16 @@ class FleetRouter:
                 attrs={"replica": rid, "kind": kind,
                        "attempt": attempt},
             )
+        # §36: work landing on a dedicated prefill replica is flagged
+        # for post-prefill export — provided a decode-capable peer
+        # exists to receive it (re-checked at export time; a vanished
+        # peer just means the prefill replica decodes this one itself).
+        migrate = self._role(rid) == "prefill" and any(
+            r != rid and r not in self._draining
+            and self._role(r) in ("decode", "mixed")
+            and self._replicas[r].alive()
+            for r in self._replicas
+        )
         item = WorkItem(
             request_id=req.request_id,
             attempt=attempt,
@@ -958,6 +1282,7 @@ class FleetRouter:
             deadline_s=deadline_s,
             slo_class=req.slo_class,
             trace=aspan.carrier() if aspan is not None else None,
+            migrate_after_prefill=migrate,
         )
         try:
             fault_point(

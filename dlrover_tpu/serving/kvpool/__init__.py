@@ -8,7 +8,10 @@ prefix reuse, SLO-class admission riding the serving scheduler.
 - :class:`PagedServingEngine` — the flat engine's step loop over block
   tables threaded as traced args (zero retraces across admissions),
   prefix-hit prefill skipping, pool-pressure relief (cache eviction →
-  youngest-request preemption).
+  youngest-request preemption);
+- ``migrate`` (§36) — a request's blocks + scheduler state as bytes:
+  export from one engine, decode-entry import into another (the
+  disaggregated-serving / live-drain primitive).
 """
 
 from dlrover_tpu.serving.kvpool.allocator import (
@@ -19,6 +22,15 @@ from dlrover_tpu.serving.kvpool.engine import (
     SENTINEL_BLOCK,
     PagedServingEngine,
 )
+from dlrover_tpu.serving.kvpool.migrate import (
+    MigrationError,
+    MigrationRefused,
+    can_import,
+    export_request,
+    import_request,
+    peek_header,
+    release_exported,
+)
 from dlrover_tpu.serving.kvpool.prefix_cache import PrefixCache
 
 __all__ = [
@@ -27,4 +39,11 @@ __all__ = [
     "PrefixCache",
     "PagedServingEngine",
     "SENTINEL_BLOCK",
+    "MigrationError",
+    "MigrationRefused",
+    "can_import",
+    "export_request",
+    "import_request",
+    "peek_header",
+    "release_exported",
 ]

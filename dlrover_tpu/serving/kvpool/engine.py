@@ -77,6 +77,8 @@ class _PagedSteps(NamedTuple):
     prefill: object
     decode: object
     cow: object
+    imp: object          # migration import: host block rows -> pool[dst]
+    exp: object          # migration export: pool[src] -> one block's rows
     trace_counts: Dict[str, int]
 
 
@@ -307,6 +309,50 @@ def _build_cow_copy(counts, quantized: bool = False):
         return k, v, ks, vs
 
     return cow_q8 if quantized else cow
+
+
+def _build_import_scatter(counts, quantized: bool = False):
+    """Migration import (kvpool/migrate, §36): land one migrated
+    block's rows — host data, shape [L, block_size, kh, hd] (+ scale
+    rows for int8) — at pool row ``dst``. ``dst`` is a traced scalar
+    like the COW src/dst, so importing any number of requests into any
+    blocks never retraces."""
+
+    def imp(k, v, dk, dv, dst):
+        counts["imp"] += 1  # traces only
+        k = k.at[:, dst].set(dk.astype(k.dtype))
+        v = v.at[:, dst].set(dv.astype(v.dtype))
+        return k, v
+
+    def imp_q8(k, v, ks, vs, dk, dv, dks, dvs, dst):
+        counts["imp"] += 1  # traces only
+        k = k.at[:, dst].set(dk)
+        v = v.at[:, dst].set(dv)
+        ks = ks.at[:, dst].set(dks)
+        vs = vs.at[:, dst].set(dvs)
+        return k, v, ks, vs
+
+    return imp_q8 if quantized else imp
+
+
+def _build_export_gather(counts, quantized: bool = False):
+    """Migration export (kvpool/migrate, §36): read one block's rows
+    out of the pool at row ``src`` — the gather mirror of the import
+    scatter. ``src`` is a traced scalar, so exporting a request of ANY
+    block count is n calls of one compiled program; the jnp
+    fancy-index alternative (``k[:, ids]``) recompiles per block-count
+    and stalled the serve loop ~400ms per new shape on CPU. No pool
+    donation: the request stays live on the source until released."""
+
+    def exp(k, v, src):
+        counts["exp"] += 1  # traces only
+        return k[:, src], v[:, src]
+
+    def exp_q8(k, v, ks, vs, src):
+        counts["exp"] += 1  # traces only
+        return k[:, src], v[:, src], ks[:, src], vs[:, src]
+
+    return exp_q8 if quantized else exp
 
 
 def _build_paged_verify(config, slots: int, max_blocks: int,
@@ -553,7 +599,7 @@ def _paged_steps(
     engine's lru_cache discipline). Pools donated; tables/lengths/ids
     all plain traced arguments. ``kv_dtype`` "int8" programs also
     donate the scale pools."""
-    counts = {"prefill": 0, "decode": 0, "cow": 0}
+    counts = {"prefill": 0, "decode": 0, "cow": 0, "imp": 0, "exp": 0}
     quantized = kv_dtype == "int8"
     pool_args = (0, 1, 2, 3) if quantized else (0, 1)
     decode = jax.jit(
@@ -570,8 +616,15 @@ def _paged_steps(
         _build_cow_copy(counts, quantized=quantized),
         donate_argnums=pool_args,
     )
+    imp = jax.jit(
+        _build_import_scatter(counts, quantized=quantized),
+        donate_argnums=pool_args,
+    )
+    # No donation: export reads the pools and the source keeps serving
+    # from them until the importer acks.
+    exp = jax.jit(_build_export_gather(counts, quantized=quantized))
     return _PagedSteps(prefill=prefill, decode=decode, cow=cow,
-                       trace_counts=counts)
+                       imp=imp, exp=exp, trace_counts=counts)
 
 
 class PagedServingEngine(ServingEngine):
@@ -778,6 +831,23 @@ class PagedServingEngine(ServingEngine):
             self._rng, np.int32(0),
         )
         pools = self._steps.cow(*pools, np.int32(0), np.int32(0))
+        blk_shape = (
+            self.config.n_layers, self.block_size,
+            self.config.n_kv_heads, self.config.head_dim,
+        )
+        if self._quantized:
+            z8 = jnp.zeros(blk_shape, jnp.int8)
+            zs = jnp.zeros(blk_shape[:-1], jnp.float32)
+            pools = self._steps.imp(
+                *pools, z8, z8, zs, zs, np.int32(0)
+            )
+        else:
+            # Import hands dequantized f32 host rows (kvpool/migrate).
+            zf = jnp.zeros(blk_shape, jnp.float32)
+            pools = self._steps.imp(*pools, zf, zf, np.int32(0))
+        # Export gather (non-donating): warm so the first migration
+        # out of this engine never stalls the serve loop on a compile.
+        jax.block_until_ready(self._steps.exp(*pools, np.int32(0)))
         if self._spec is not None:
             tbl = jnp.asarray(
                 np.zeros((self.slots, self.max_blocks), np.int32)

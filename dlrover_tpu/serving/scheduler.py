@@ -152,6 +152,14 @@ class Request:
     spec_accepted: int = 0
     draft_s: float = 0.0
     verify_s: float = 0.0
+    # Block migration (serving/kvpool/migrate, §36): set on the
+    # DESTINATION engine at import. The migrate window sits between
+    # the (source-side) prefill and the local decode in the
+    # retrospective span tree; all four stamps live on the local
+    # monotonic clock (import reconstructs the source phases from
+    # carried durations).
+    migrate_start_ts: Optional[float] = None
+    migrate_end_ts: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:
@@ -329,6 +337,75 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def admit_decode(
+        self,
+        prompt,
+        tokens: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        slo_class: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> Request:
+        """DECODE-entry admission (§36): bind a FREE slot directly in
+        DECODE state for a request whose prefill already ran elsewhere
+        (block migration). No queue, no prefill — the caller installs
+        blocks/table/fill and owns the timeline stamps; this method
+        seeds them with ``now`` so an un-adjusted request still has a
+        consistent (zero-width) phase history. Raises when no slot is
+        free — the import path must check :meth:`free_slots` first."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if prompt.shape[0] >= self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt.shape[0]} leaves no decode room "
+                f"in max_len {self.max_len}"
+            )
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError(
+                "decode-entry admission needs >= 1 sampled token "
+                "(prefill must have completed at the source)"
+            )
+        if len(tokens) >= max_new_tokens:
+            raise ValueError(
+                f"request already complete ({len(tokens)} of "
+                f"{max_new_tokens} tokens) — nothing to migrate"
+            )
+        cls_name = slo_class if slo_class is not None else (
+            self._default_class
+        )
+        if cls_name not in self.slo_classes:
+            raise ValueError(
+                f"unknown SLO class {cls_name!r}; configured: "
+                f"{sorted(self.slo_classes)}"
+            )
+        if not self._free:
+            raise RuntimeError(
+                "no free slot for decode-entry admission"
+            )
+        if now is None:
+            now = time.monotonic()
+        req = Request(
+            rid=next(self._rid),
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            temperature=float(temperature),
+            state=DECODE,
+            slo_class=cls_name,
+            submit_ts=now,
+        )
+        req.admit_ts = now
+        req.first_token_ts = now
+        req.prefill_pos = int(prompt.shape[0])
+        req.tokens = tokens
+        req.slot = self._free.popleft()
+        self.by_slot[req.slot] = req
+        return req
+
     def _next_admission(self, now: float) -> Optional[Request]:
         """The weighted-fair winner among per-class queue heads;
         expired candidates are shed on the way (admission-time TTL).
@@ -481,6 +558,8 @@ class Scheduler:
         req.first_token_ts = None
         req.admit_ts = None
         req.prefix_hit_blocks = 0
+        req.migrate_start_ts = None
+        req.migrate_end_ts = None
         self._reset_spec_progress(req)
         req.preemptions += 1
         self.queue.appendleft(req)
@@ -518,6 +597,8 @@ class Scheduler:
             req.first_token_ts = None
             req.admit_ts = None
             req.prefix_hit_blocks = 0
+            req.migrate_start_ts = None
+            req.migrate_end_ts = None
             self._reset_spec_progress(req)
             req.requeues += 1
             self.queue.appendleft(req)

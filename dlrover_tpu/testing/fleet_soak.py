@@ -84,6 +84,28 @@ def build_fleet_schedules(
     return schedules
 
 
+def build_migration_schedules(
+    seed: int, episode: int, cfg: Optional[FleetSoakConfig] = None
+) -> Dict[str, FaultSchedule]:
+    """Deterministic schedule for ``kill_during_migration`` (seed,
+    episode): the DESTINATION decode replica (always replica 1 in the
+    two-replica prefill+decode topology) is SIGKILLed at the
+    ``fleet.replica.import`` fault point — after the source exported
+    the KV payload, before the import ack is emitted. The nth import
+    it dies on is the seeded part."""
+    cfg = cfg or FleetSoakConfig()
+    ep_seed = seed * 10007 + episode
+    rng = random.Random(ep_seed ^ 0x3160)
+    victim = "1"  # the decode tier of the 2-replica split topology
+    kill_nth = rng.randint(1, 3)
+    return {
+        victim: FaultSchedule([
+            FaultRule("fleet.replica.import", action="crash",
+                      nth=kill_nth, rule_id="dst-sigkill-mid-import"),
+        ], seed=ep_seed, label=f"replica{victim}"),
+    }
+
+
 def run_fleet_episode(
     seed: int,
     episode: int = 4,
@@ -314,12 +336,304 @@ def run_fleet_episode(
     return report
 
 
-def _check_trace_invariant(spans, require_reroute: bool) -> Dict:
+def run_migration_episode(
+    seed: int,
+    episode: int = 6,
+    cfg: Optional[FleetSoakConfig] = None,
+    work_dir: Optional[str] = None,
+    artifact_dir: Optional[str] = None,
+    runner_schedule: Optional[FaultSchedule] = None,
+) -> Dict:
+    """One ``kill_during_migration`` episode (§36): a prefill+decode
+    split fleet serves a seeded stream, and the DESTINATION replica is
+    SIGKILLed between the source's export and the import ack — the
+    moment a migrating request's KV payload exists on the wire but
+    nowhere durable. Asserted afterwards:
+
+    - **exactly-once**: every accepted request completes or fails
+      exactly once — the killed import's request finishes on its
+      never-released SOURCE (option-B fallback), no duplicates;
+    - **zero lost blocks**: block conservation holds on every replica
+      at every heartbeat, across the victim's kill and restart;
+    - **the window actually fired** (fault trace) and the fleet
+      healed: victim walked BROKEN -> HALF_OPEN -> HEALTHY, and at
+      least one migration SUCCEEDED after the restart (decode-role
+      breakers are probed by migration traffic, so the success IS the
+      probe).
+
+    Raises SoakInvariantError (artifacts kept) on violation."""
+    import tempfile
+
+    cfg = cfg or FleetSoakConfig()
+    work_dir = work_dir or tempfile.mkdtemp(prefix="dlrover_migsoak_")
+    artifact_dir = artifact_dir or os.path.join(work_dir, "artifacts")
+    ep_dir = os.path.join(work_dir, f"mig-s{seed}-e{episode}")
+    shutil.rmtree(ep_dir, ignore_errors=True)
+    os.makedirs(ep_dir, exist_ok=True)
+    ep_seed = seed * 10007 + episode
+    rng = random.Random(ep_seed ^ 0x5EED)
+    schedules = build_migration_schedules(seed, episode, cfg)
+    victim = next(iter(schedules))
+
+    schedule_paths: Dict[str, str] = {}
+    for rid, sched in schedules.items():
+        path = os.path.join(ep_dir, f"schedule_replica{rid}.json")
+        with open(path, "w") as f:
+            f.write(sched.to_json())
+        schedule_paths[rid] = path
+
+    from dlrover_tpu.observability.registry import MetricsRegistry
+
+    registry = MetricsRegistry()
+    prev_tracer = tracing.active_tracer()
+    router_sink = os.path.join(ep_dir, "spans_router.jsonl")
+    tracing.arm(tracing.Tracer(service="router", sink_path=router_sink))
+
+    def _restore_tracer():
+        tracing.disarm()
+        if prev_tracer is not None:
+            tracing.arm(prev_tracer)
+
+    try:
+        replicas = [
+            SubprocessReplica(
+                str(i), ep_dir,
+                slots=cfg.slots, max_len=cfg.max_len,
+                prefill_chunk=cfg.prefill_chunk,
+                paged=True, block_size=cfg.block_size,
+                role="prefill" if i == 0 else "decode",
+                # Generation 0 only: post-restart generations run
+                # clean so re-admission probes can succeed.
+                schedule_path=(
+                    [schedule_paths[str(i)]]
+                    if str(i) in schedule_paths else ""
+                ),
+            )
+            for i in range(2)
+        ]
+        router = FleetRouter(
+            replicas,
+            RouterConfig(
+                max_retries=3,
+                seed=ep_seed,
+                # Short enough that the killed import's pending entry
+                # is pruned (reason="timeout") within the episode.
+                migration_timeout_s=2.0,
+                health=HealthPolicy(
+                    heartbeat_timeout_s=2.0,
+                    probe_cooldown_s=0.5,
+                    probe_successes=2,
+                ),
+            ),
+            registry=registry,
+        )
+    except BaseException:
+        _restore_tracer()
+        raise
+    if runner_schedule is not None:
+        arm(runner_schedule)
+
+    health_seen = {rid: set() for rid in router._replicas}  # noqa: SLF001
+
+    def note_health():
+        for rid in health_seen:
+            health_seen[rid].add(router.health_state(rid))
+
+    def migrations_ok() -> int:
+        return int(registry.get("fleet_migrations_total").value())
+
+    t_start = time.time()
+    deadline = t_start + cfg.watchdog_s
+    accepted: List = []
+    failure: Optional[str] = None
+    vocab_hi = 100
+    try:
+        router.start(timeout_s=min(120.0, cfg.watchdog_s))
+        to_submit = [
+            (
+                [rng.randint(1, vocab_hi) for _ in
+                 range(rng.randint(4, 10))],
+                cfg.new_tokens_long if rng.random() < 0.5
+                else cfg.new_tokens_short,
+            )
+            for _ in range(cfg.requests)
+        ]
+        while to_submit or router.pending():
+            if time.time() > deadline:
+                failure = "watchdog: migration episode deadline exceeded"
+                break
+            if to_submit:
+                prompt, new = to_submit.pop(0)
+                accepted.append(router.submit(prompt, new))
+            router.step()
+            note_health()
+            time.sleep(0.005)
+        # Recovery half: keep feeding prompts (they prefill on replica
+        # 0 and try to migrate) until the victim's breaker closes AND
+        # a post-kill migration has actually succeeded — migration
+        # traffic is the decode tier's probe path.
+        while not failure and (
+            router.health_state(victim) != HEALTHY or migrations_ok() < 1
+        ):
+            if time.time() > deadline:
+                failure = (
+                    f"watchdog: victim {victim} never re-admitted via "
+                    f"migration probes (state "
+                    f"{router.health_state(victim)}, "
+                    f"migrations_ok={migrations_ok()})"
+                )
+                break
+            if router.pending() == 0:
+                accepted.append(router.submit(
+                    [rng.randint(1, vocab_hi) for _ in range(5)],
+                    cfg.new_tokens_short,
+                ))
+            router.step()
+            note_health()
+            time.sleep(0.005)
+        if not failure:
+            try:
+                router.run_until_idle(
+                    timeout_s=max(1.0, deadline - time.time())
+                )
+            except TimeoutError as e:
+                failure = f"watchdog: {e}"
+    finally:
+        if runner_schedule is not None:
+            disarm()
+        router.stop()
+        _restore_tracer()
+
+    wall = time.time() - t_start
+    report: Dict = {
+        "episode": episode,
+        "seed": seed,
+        "kind": "kill_during_migration",
+        "wall_s": round(wall, 3),
+        "victim": victim,
+        "requests": len(accepted),
+    }
+    import glob as glob_lib
+
+    episode_spans = tracing.load_spans(
+        [router_sink]
+        + sorted(glob_lib.glob(
+            os.path.join(ep_dir, "spans_replica*.jsonl")
+        ))
+    )
+    try:
+        if failure:
+            raise SoakInvariantError(failure)
+        _check_fleet_invariant(
+            accepted, router, registry, victim, health_seen
+        )
+        kv_final = _check_block_reclaim(replicas, victim)
+        report["kv_blocks"] = kv_final
+        # §36 phase-sum law on REAL migrated requests: queue + prefill
+        # + migrate + decode ≈ e2e, and at least one verified tree
+        # must actually carry the migrate phase — this episode is the
+        # one place migrations are guaranteed to have happened.
+        report["trace"] = _check_trace_invariant(
+            episode_spans,
+            require_reroute=registry.get(
+                "fleet_reroutes_total"
+            ).value() >= 1,
+            require_migrate=True,
+        )
+        # Migration-specific law: the kill window fired (the victim's
+        # fault trace says so), the orphaned import was accounted as a
+        # failure (timeout or send-error — never a silent loss), and a
+        # migration completed end-to-end afterwards.
+        fault_trace = _read_trace(
+            os.path.join(ep_dir, f"trace_replica{victim}.jsonl"),
+            f"replica{victim}",
+        )
+        fired = [
+            t for t in fault_trace
+            if t.get("point") == "fleet.replica.import"
+            and t.get("action") == "crash"
+        ]
+        if not fired:
+            raise SoakInvariantError(
+                "kill_during_migration: the import-window SIGKILL "
+                "never fired — the episode tested nothing"
+            )
+        fails = sum(
+            v for _n, _l, v in registry.get(
+                "fleet_migration_failures_total"
+            ).samples()
+        )
+        if fails < 1:
+            raise SoakInvariantError(
+                "destination died holding an unacked import but no "
+                "migration failure was recorded"
+            )
+        if migrations_ok() < 1:
+            raise SoakInvariantError(
+                "no migration succeeded after the victim's restart"
+            )
+    except SoakInvariantError as e:
+        dest = _dump_artifacts(
+            ep_dir, artifact_dir, schedules, seed, episode, str(e)
+        )
+        logger.error(
+            "MIGRATION EPISODE FAILED: %s\n  artifacts: %s", e, dest
+        )
+        raise
+    results = [r.result for r in accepted if r.result is not None]
+    completed = [r for r in results if r.ok]
+    report.update({
+        "productive_step_s": round(sum(
+            r.latency_s or 0.0 for r in completed
+        ), 3),
+        "goodput_frac": round(
+            len(completed) / max(len(results), 1), 4
+        ),
+        "completed": len(completed),
+        "failed": len(results) - len(completed),
+        "migrations": migrations_ok(),
+        "migration_failures": int(sum(
+            v for _n, _l, v in registry.get(
+                "fleet_migration_failures_total"
+            ).samples()
+        )),
+        "restarts": int(
+            registry.get("fleet_replica_restarts_total").value()
+        ),
+        "duplicates": int(
+            registry.get("fleet_duplicate_completions_total").value()
+        ),
+        "deaths": 1,
+        "recovery_s": [],
+        "steps_unique": len(completed),
+        "steps_executed": len(results),
+        "faults": [
+            t
+            for rid in schedules
+            for t in _read_trace(
+                os.path.join(ep_dir, f"trace_replica{rid}.jsonl"),
+                f"replica{rid}",
+            )
+        ],
+    })
+    if not cfg.keep_artifacts_on_success:
+        shutil.rmtree(ep_dir, ignore_errors=True)
+    return report
+
+
+def _check_trace_invariant(spans, require_reroute: bool,
+                           require_migrate: bool = False) -> Dict:
     """The §29 trace proof: (a) a rerouted request's tree shows the
     failed attempt and the retry as SIBLING spans under one
-    fleet.request root; (b) queue-wait + prefill + decode child spans
-    sum to the serving.request e2e duration within 10%."""
+    fleet.request root; (b) the lifecycle child spans — queue-wait +
+    prefill (+ migrate, when the fleet moved the request's KV between
+    tiers, §36) + decode — sum to the serving.request e2e duration
+    within 10%: the phases TILE the request, so the migrate row in
+    ``trace_query.py --serving`` is an honest share of request time,
+    not an overlap artifact. With ``require_migrate`` at least one
+    phase-sum-verified tree must carry a ``serving.migrate`` child."""
     rerouted = 0
+    migrate_checked = 0
     for tree in tracing.build_trees(spans):
         if tree.get("name") != "fleet.request":
             continue
@@ -353,16 +667,27 @@ def _check_trace_invariant(spans, require_reroute: bool) -> Dict:
         if abs(phase_sum - e2e) > max(0.1 * e2e, 0.005):
             raise SoakInvariantError(
                 f"trace {record.get('trace_id')}: queue-wait + prefill "
-                f"+ decode sum {phase_sum:.4f}s vs e2e {e2e:.4f}s — "
-                f"phases no longer partition the request"
+                f"(+ migrate) + decode sum {phase_sum:.4f}s vs e2e "
+                f"{e2e:.4f}s — phases no longer partition the request"
             )
         checked += 1
+        if any(s.get("name") == "serving.migrate" for s in children):
+            migrate_checked += 1
     if checked == 0:
         raise SoakInvariantError(
             "no completed serving.request span carried its full "
             "queue-wait/prefill/decode phase tree"
         )
-    return {"rerouted_trees": rerouted, "phase_sum_checked": checked}
+    if require_migrate and migrate_checked == 0:
+        raise SoakInvariantError(
+            "migrations ran but no phase-sum-verified serving.request "
+            "tree carries a serving.migrate child span"
+        )
+    return {
+        "rerouted_trees": rerouted,
+        "phase_sum_checked": checked,
+        "migrate_phase_checked": migrate_checked,
+    }
 
 
 def _check_block_reclaim(replicas, victim) -> Dict:

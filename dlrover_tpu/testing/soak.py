@@ -69,6 +69,15 @@ EPISODE_KINDS = (
     # dlrover_tpu/testing/autoscale_soak.py). Appended so episodes 0-4
     # keep their (seed, episode) -> plan identity.
     "straggler_evict",
+    # Episode 6: a prefill+decode split fleet (§36) has its DESTINATION
+    # replica SIGKILLed between the source's KV export and the import
+    # ack — the payload exists on the wire but nowhere durable. The
+    # never-released source must complete the request exactly once,
+    # block conservation must hold on both ends across the kill, and a
+    # migration must succeed again after the breaker-gated restart
+    # (delegated to dlrover_tpu/testing/fleet_soak.py). Appended so
+    # episodes 0-5 keep their (seed, episode) -> plan identity.
+    "kill_during_migration",
 )
 
 
@@ -199,6 +208,16 @@ def build_episode_plan(
             nth=rng.randint(2, 6),
             rule_id="drop-router-dispatch",
         ))
+    elif kind == "kill_during_migration":
+        # The destination-replica SIGKILL schedule (crash at the
+        # fleet.replica.import fault point, between export and
+        # import-ack) is derived in
+        # fleet_soak.build_migration_schedules from the same ep_seed;
+        # the runner itself injects nothing extra — the episode's
+        # whole point is that ONE kill in that window already
+        # exercises timeout-prune, source fallback and the
+        # migration-probed breaker walk.
+        pass
     elif kind == "kill_during_rescale":
         # Rank 1 dies mid-step (cuts the scale-down plan); rank 0 is
         # SIGKILLed in the restore-to-first-step window of THAT plan
@@ -511,6 +530,10 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
         return _run_fleet_kind(
             seed, episode, plan, cfg, work_dir, artifact_dir
         )
+    if plan.kind == "kill_during_migration":
+        return _run_migration_kind(
+            seed, episode, plan, cfg, work_dir, artifact_dir
+        )
     if plan.kind == "straggler_evict":
         return _run_autoscale_kind(seed, episode, cfg)
     ep_dir = os.path.join(work_dir, f"soak-s{seed}-e{episode}")
@@ -744,6 +767,39 @@ def _run_fleet_kind(seed, episode, plan, cfg, work_dir, artifact_dir):
     )
     try:
         return run_fleet_episode(
+            seed,
+            episode=episode,
+            cfg=fcfg,
+            work_dir=work_dir,
+            artifact_dir=artifact_dir,
+            runner_schedule=plan.runner_schedule,
+        )
+    except SoakInvariantError:
+        print(
+            f"  repro: python tools/chaos_soak.py --seed {seed} "
+            f"--episode {episode}",
+            file=sys.stderr, flush=True,
+        )
+        raise
+
+
+def _run_migration_kind(seed, episode, plan, cfg, work_dir,
+                        artifact_dir):
+    """Episode kind 6 (kill_during_migration): delegate to the fleet
+    harness's §36 scenario — a prefill+decode split fleet whose
+    destination replica is SIGKILLed between KV export and import ack.
+    The report is already soak-shaped."""
+    from dlrover_tpu.testing.fleet_soak import (
+        FleetSoakConfig,
+        run_migration_episode,
+    )
+
+    fcfg = FleetSoakConfig(
+        watchdog_s=cfg.watchdog_s,
+        keep_artifacts_on_success=cfg.keep_artifacts_on_success,
+    )
+    try:
+        return run_migration_episode(
             seed,
             episode=episode,
             cfg=fcfg,

@@ -188,6 +188,51 @@ def test_kv_quant_round_trip_and_idempotency():
     assert np.all(np.asarray(qz) == 0) and np.all(np.asarray(sz) > 0)
 
 
+def test_kv_wire_roundtrip_fp_and_int8():
+    """Migration wire format: fp KV packs int8-on-the-wire within the
+    quantization bound; int8 KV roundtrips bit-exact and the pack of an
+    unpack is byte-identical (idempotent — re-exporting a migrated
+    request costs zero extra error)."""
+    from dlrover_tpu.ops.kv_quant import (
+        dequantize_kv,
+        kv_from_wire,
+        kv_to_wire,
+        quantize_kv,
+    )
+
+    rk, rv = jax.random.split(jax.random.key(7))
+    k = jax.random.normal(rk, (2, 3, 8, 4, 16), jnp.float32)
+    v = jax.random.normal(rv, (2, 3, 8, 4, 16), jnp.float32)
+    # fp source: quantized on pack, reconstruction within amax/254.
+    buf = kv_to_wire(k, v)
+    kq, vq, ks, vs, header = kv_from_wire(buf)
+    assert header["src_dtype"] == "float32"
+    assert kq.dtype == np.int8 and ks.dtype == np.float32
+    for deq, ref, s in (
+        (dequantize_kv(jnp.asarray(kq), jnp.asarray(ks)), k, ks),
+        (dequantize_kv(jnp.asarray(vq), jnp.asarray(vs)), v, vs),
+    ):
+        bound = np.asarray(s)[..., None] / 2 + 1e-7
+        assert np.all(np.abs(np.asarray(deq) - np.asarray(ref)) <= bound)
+    # int8 source: scales inline, bit-exact passthrough + idempotent
+    # pack(unpack(buf)) == buf.
+    q8k, s8k = quantize_kv(k)
+    q8v, s8v = quantize_kv(v)
+    buf8 = kv_to_wire(q8k, q8v, k_scale=s8k, v_scale=s8v)
+    kq2, vq2, ks2, vs2, header2 = kv_from_wire(buf8)
+    assert header2["src_dtype"] == "int8"
+    np.testing.assert_array_equal(kq2, np.asarray(q8k))
+    np.testing.assert_array_equal(vs2, np.asarray(s8v, np.float32))
+    assert kv_to_wire(kq2, vq2, k_scale=ks2, v_scale=vs2) == buf8
+    # Truncation and bad magic fail loudly.
+    with pytest.raises(ValueError):
+        kv_from_wire(buf8[:-3])
+    with pytest.raises(ValueError):
+        kv_from_wire(b"XXXX" + buf8[4:])
+    with pytest.raises(ValueError):
+        kv_to_wire(np.asarray(q8k), np.asarray(q8v))  # int8 sans scales
+
+
 def test_int8_generate_logit_tolerance_and_greedy_tokens():
     """Pinned acceptance bound: int8-KV greedy decoding stays within a
     small logit distance of fp and is TOKEN-EXACT on the pinned bench

@@ -1,0 +1,294 @@
+"""Disaggregated prefill/decode micro-bench: KV migration as the
+hand-off between a prefill fleet and a decode fleet (§36).
+
+Drives the REAL fleet (``dlrover_tpu/serving/fleet``) — a
+:class:`FleetRouter` over paged subprocess replicas — through one
+seeded Poisson schedule of BIMODAL prompts (a long-prompt mode mixed
+into a short-prompt stream) two ways at EQUAL replica count:
+
+1. **co-located**: every replica is ``mixed`` — prefill chunks and
+   decode iterations interleave on the same engine, so a long prompt's
+   prefill steals engine iterations from every decoding request behind
+   it (the head-of-line blocking this PR exists to remove).
+2. **disaggregated**: half the replicas are ``prefill``, half
+   ``decode``. Prompts prefill on the prefill tier, then the router
+   migrates the request's KV blocks (int8 on the wire) to the
+   least-loaded decode replica at the first DECODE boundary; the
+   source keeps decoding until the import is acked, so a refused or
+   failed migration costs nothing but the fallback.
+
+Same schedule, same engines both ways, with the workers' roofline
+service-time simulation (flat memory-bound read per iteration that
+the decode batch amortizes + compute-bound microseconds per prefill
+token — see ``replica_worker.py --token-delay-us``). What's measured
+is the SERVING PLANE: TTFT tail (does isolating prefill from decode
+interference flatten it?), decode inter-token latency (does removing
+prompt chunks from decode batches steady it?), aggregate tokens/s
+(does splitting the fleet cost throughput?), and the migration pause
+itself (export receipt to import ack on the router clock — the
+window a migrating request makes no progress).
+
+Wired into ``bench.py`` as the ``disagg`` phase; also runs standalone:
+
+    python tools/bench_disagg.py --replicas 4 --requests 32
+
+Prints one JSON line. Scoreboard: ``ttft_p99_improvement`` (co-located
+p99 over disagg p99 — >1 means disagg flattened the tail),
+``tokens_per_s_ratio`` (disagg over co-located — parity is the bar),
+``migration_pause_ms_mean`` and ``migrations``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.observability.registry import MetricsRegistry  # noqa: E402
+from dlrover_tpu.serving.fleet import (  # noqa: E402
+    FleetRouter,
+    HealthPolicy,
+    RouterConfig,
+    SubprocessReplica,
+)
+
+
+def make_workload(n_requests: int, seed: int):
+    """[(arrival_s, prompt, max_new)] — Poisson arrivals, bimodal
+    prompt lengths (65% short conversational turns, 35% long-context
+    prompts whose chunked prefill occupies many engine iterations).
+    The long mode is what disaggregation is FOR: co-located, those
+    prefill iterations block every decode behind them; disaggregated,
+    they land on the prefill tier and the decode tier never sees
+    them. Output lengths stay moderate so the run is prefill-heavy
+    the way a long-prompt serving mix actually is."""
+    rs = np.random.RandomState(seed)
+    arrivals = np.cumsum(rs.exponential(scale=0.125, size=n_requests))
+    work = []
+    for i in range(n_requests):
+        if rs.rand() < 0.35:
+            plen = int(rs.randint(64, 97))
+        else:
+            plen = int(rs.randint(8, 17))
+        prompt = rs.randint(1, 100, size=plen).tolist()
+        max_new = int(rs.randint(32, 65))
+        work.append((float(arrivals[i]), prompt, max_new))
+    return work
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+
+def drive(
+    roles: List[str],
+    workload,
+    work_dir: str,
+    step_delay_ms: float = 24.0,
+    token_delay_us: float = 2000.0,
+    timeout_s: float = 300.0,
+) -> Dict[str, float]:
+    """One fleet run over the arrival schedule (wall-clock real time)
+    with one paged replica per entry in ``roles``.
+
+    Service time is the ROOFLINE simulation the workers implement:
+    every iteration pays the flat ``step_delay_ms`` (the memory-bound
+    weight/KV read, which the whole decode batch amortizes — decode
+    batching is nearly free, exactly why concentrating decodes on a
+    decode tier costs nothing) plus ``token_delay_us`` per PREFILL
+    token in the iteration's prompt chunk (the compute-bound term).
+    Total prefill compute is conserved across fleet shapes, so
+    aggregate tokens/s parity is the fair bar; what differs is WHERE
+    the chunks run — inside decoding batches (co-located) or on a
+    tier with none (disaggregated).
+
+    Engine config is PER-ROLE — the systems point of disaggregation:
+    a dedicated prefill replica runs a big prefill chunk (16) because
+    it has no co-resident decoders whose inter-token latency a big
+    chunk would wreck; mixed and decode replicas keep the
+    latency-protecting chunk (4). Decode-side slots are generous (16)
+    so admission — and on the decode tier, import headroom — is never
+    the bottleneck and what is measured is the iteration-level
+    interference itself: a mixed replica advances one 4-token prompt
+    chunk per iteration while dragging its whole decode batch's
+    inter-token latency through every chunk. The prefill tier keeps
+    slots modest (6): its residents are prompts mid-chunking plus the
+    handful of just-prefilled requests decoding out their migration
+    window, and a full decode tier must push back HERE (refused
+    imports fall back to source decode) rather than admit-and-thrash
+    there."""
+    replicas = [
+        SubprocessReplica(
+            str(i), work_dir,
+            slots=6 if role == "prefill" else 16, max_len=160,
+            prefill_chunk=16 if role == "prefill" else 4,
+            heartbeat_s=0.1,
+            step_delay_ms=step_delay_ms,
+            token_delay_us=token_delay_us,
+            paged=True, block_size=8,
+            role=role,
+        )
+        for i, role in enumerate(roles)
+    ]
+    router = FleetRouter(
+        replicas,
+        RouterConfig(
+            max_retries=3,
+            health=HealthPolicy(
+                heartbeat_timeout_s=1.0, probe_cooldown_s=0.5
+            ),
+        ),
+        registry=MetricsRegistry(),
+    )
+    submitted = []
+    try:
+        router.start(timeout_s=timeout_s)
+        t0 = time.monotonic()
+        pending = list(workload)
+        while pending or router.pending():
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"disagg bench run did not drain in {timeout_s}s"
+                )
+            now = time.monotonic() - t0
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.pop(0)
+                submitted.append(router.submit(prompt, max_new))
+            if not router.step():
+                time.sleep(0.002)
+        wall = time.monotonic() - t0
+    finally:
+        router.stop()
+    results = [r.result for r in submitted if r.result is not None]
+    lost = [r.request_id for r in submitted if r.result is None]
+    assert not lost, f"disagg bench lost requests silently: {lost}"
+    completed = [r for r in results if r.ok]
+    decoded = sum(len(r.tokens) for r in completed)
+    ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
+    # Inter-token latency: decode-phase seconds per generated token —
+    # the metric a mixed replica's prefill chunks inflate for every
+    # decoding neighbour.
+    itls = [
+        (r.latency_s - r.ttft_s) / (len(r.tokens) - 1)
+        for r in completed
+        if r.ttft_s is not None and r.latency_s is not None
+        and len(r.tokens) > 1
+    ]
+    reg = router.metrics
+    pause_n = reg.migration_pause.count()
+    fail_total = sum(
+        v for _, _, v in reg.migration_failures.samples()
+    )
+    return {
+        "wall_s": wall,
+        "completed": len(completed),
+        "completed_frac": len(completed) / max(len(results), 1),
+        "decoded_tokens": decoded,
+        "tokens_per_s": decoded / max(wall, 1e-9),
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p99_s": _percentile(ttfts, 99),
+        "itl_p50_s": _percentile(itls, 50),
+        "itl_p99_s": _percentile(itls, 99),
+        "migrations": reg.migrations.value(),
+        "migration_failures": fail_total,
+        "migration_pause_ms_mean": (
+            1e3 * reg.migration_pause.sum() / pause_n if pause_n else 0.0
+        ),
+        "migration_pause_ms_p50": 1e3 * (
+            reg.migration_pause.quantile(0.5) or 0.0
+        ),
+        "retries": reg.retries.value(),
+    }
+
+
+def run_bench(
+    replicas: int = 4,
+    n_requests: int = 32,
+    seed: int = 0,
+    step_delay_ms: float = 24.0,
+    token_delay_us: float = 2000.0,
+    timeout_s: float = 300.0,
+) -> Dict[str, float]:
+    workload = make_workload(n_requests, seed)
+    n_prefill = max(1, replicas // 2)
+    n_decode = max(1, replicas - n_prefill)
+    out: Dict[str, float] = {
+        "replicas": replicas,
+        "requests": n_requests,
+        "prefill_replicas": n_prefill,
+        "decode_replicas": n_decode,
+    }
+    with tempfile.TemporaryDirectory(prefix="dlrover_bdisagg_") as wd:
+        coloc = drive(
+            ["mixed"] * (n_prefill + n_decode), workload,
+            os.path.join(wd, "coloc"),
+            step_delay_ms=step_delay_ms,
+            token_delay_us=token_delay_us, timeout_s=timeout_s,
+        )
+        disagg = drive(
+            ["prefill"] * n_prefill + ["decode"] * n_decode, workload,
+            os.path.join(wd, "disagg"),
+            step_delay_ms=step_delay_ms,
+            token_delay_us=token_delay_us, timeout_s=timeout_s,
+        )
+    out.update({
+        "coloc_tokens_per_s": round(coloc["tokens_per_s"], 1),
+        "coloc_ttft_p50_s": round(coloc["ttft_p50_s"], 4),
+        "coloc_ttft_p99_s": round(coloc["ttft_p99_s"], 4),
+        "coloc_itl_p50_s": round(coloc["itl_p50_s"], 4),
+        "coloc_itl_p99_s": round(coloc["itl_p99_s"], 4),
+        "tokens_per_s": round(disagg["tokens_per_s"], 1),
+        "ttft_p50_s": round(disagg["ttft_p50_s"], 4),
+        "ttft_p99_s": round(disagg["ttft_p99_s"], 4),
+        "itl_p50_s": round(disagg["itl_p50_s"], 4),
+        "itl_p99_s": round(disagg["itl_p99_s"], 4),
+        "ttft_p99_improvement": round(
+            coloc["ttft_p99_s"] / max(disagg["ttft_p99_s"], 1e-9), 2
+        ),
+        "itl_p99_improvement": round(
+            coloc["itl_p99_s"] / max(disagg["itl_p99_s"], 1e-9), 2
+        ),
+        "tokens_per_s_ratio": round(
+            disagg["tokens_per_s"] / max(coloc["tokens_per_s"], 1e-9), 2
+        ),
+        "migrations": int(disagg["migrations"]),
+        "migration_failures": int(disagg["migration_failures"]),
+        "migration_pause_ms_mean": round(
+            disagg["migration_pause_ms_mean"], 2
+        ),
+        "migration_pause_ms_p50": round(
+            disagg["migration_pause_ms_p50"], 2
+        ),
+        "completed_frac": round(disagg["completed_frac"], 4),
+        "retries": int(disagg["retries"]),
+    })
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--step-delay-ms", type=float, default=24.0)
+    ap.add_argument("--token-delay-us", type=float, default=2000.0)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ns = ap.parse_args(argv)
+    out = run_bench(
+        replicas=ns.replicas, n_requests=ns.requests, seed=ns.seed,
+        step_delay_ms=ns.step_delay_ms,
+        token_delay_us=ns.token_delay_us, timeout_s=ns.timeout_s,
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

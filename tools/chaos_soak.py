@@ -1,11 +1,12 @@
 """Seeded chaos-soak CLI: drive the whole stack through reproducible
 fault episodes and assert the five system invariants.
 
-    python tools/chaos_soak.py --seed 0 --episodes 6
+    python tools/chaos_soak.py --seed 0 --episodes 7
     python tools/chaos_soak.py --seed 0 --episode 1      # repro one
     python tools/chaos_soak.py --seed 0 --episode 3      # rescale kill
     python tools/chaos_soak.py --seed 0 --episode 4      # fleet reroute
     python tools/chaos_soak.py --seed 0 --episode 5      # autoscaler A/B
+    python tools/chaos_soak.py --seed 0 --episode 6      # migration kill
 
 Each episode runs an in-process master, worker subprocesses and a
 serving engine under a deterministic seeded fault schedule (worker
@@ -51,10 +52,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="seeded chaos soak")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--episodes", type=int, default=6,
-        help="episode count; 6 covers the full fault matrix incl. "
-        "kill_during_rescale, replica_kill_reroute and the "
-        "straggler_evict autoscaler A/B",
+        "--episodes", type=int, default=7,
+        help="episode count; 7 covers the full fault matrix incl. "
+        "kill_during_rescale, replica_kill_reroute, the "
+        "straggler_evict autoscaler A/B and the §36 "
+        "kill_during_migration destination SIGKILL",
     )
     parser.add_argument(
         "--episode", type=int, default=None,

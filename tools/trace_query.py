@@ -17,9 +17,10 @@ tracing`` (``DLROVER_TPU_TRACE_FILE``, the fleet soak's
     python tools/trace_query.py --verbs spans_master.jsonl
 
     # serving request lifecycle only: serving.* spans folded into a
-    # per-phase table (queue_wait / prefill / decode, and with
-    # speculative decoding the decode.draft / decode.verify split,
-    # §35) plus each phase's share of total serving.request time
+    # per-phase table (queue_wait / prefill / migrate / decode — the
+    # migrate row is the §36 KV hand-off window between tiers — and
+    # with speculative decoding the decode.draft / decode.verify
+    # split, §35) plus each phase's share of serving.request time
     python tools/trace_query.py --serving spans_engine.jsonl
 
     # one trace's tree + critical path
@@ -81,12 +82,17 @@ def verb_summary(spans: List[Dict]) -> List[Dict]:
 def serving_summary(spans: List[Dict]) -> List[Dict]:
     """Per-phase table from the engine's ``serving.*`` request spans
     (§25/§35): one row per lifecycle phase (``queue_wait``,
-    ``prefill``, ``decode``, and — when speculation ran —
+    ``prefill``, ``decode``; ``migrate`` when the fleet migrated KV
+    between tiers, §36; and — when speculation ran —
     ``decode.draft``/``decode.verify``), the ``serving.`` prefix
     stripped, plus ``share_pct``: that phase's summed duration over
     the summed ``serving.request`` duration. The draft/verify split is
     how a speculative deployment answers "where does the step time
-    go" without a profiler attached."""
+    go" without a profiler attached; the migrate row is the same
+    question for the disaggregated hand-off — its share IS the
+    migration tax on request time (phases tile the request, so
+    queue + prefill + migrate + decode ≈ e2e — the fleet soak asserts
+    exactly this)."""
     rows = summarize([
         {**s, "name": s.get("name", "")[len("serving."):]}
         for s in spans
@@ -189,7 +195,7 @@ def main(argv=None) -> int:
                     "server spans (cross-check vs master_rpc_seconds)")
     ap.add_argument("--serving", action="store_true",
                     help="per-phase latency table from serving.* "
-                    "request spans (queue/prefill/decode + "
+                    "request spans (queue/prefill/migrate/decode + "
                     "draft/verify split, with request-time share)")
     ap.add_argument("--trace",
                     help="print one trace's tree + critical path")
