@@ -4,11 +4,12 @@ Parity: reference dlrover/python/elastic_agent/master_client.py:51-778
 (MasterClient with gRPC/HTTP transports, retry wrapper, singleton).
 """
 
+import http.client
 import os
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.comm import Message
@@ -17,6 +18,63 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.fault import fault_point
 from dlrover_tpu.observability import tracing
 from dlrover_tpu.rpc.transport import build_master_stub
+
+# Bounded master-outage ride-through window (seconds). When > 0, a verb
+# whose per-call retry budget exhausts on a connection-class error keeps
+# re-trying under long jittered sleeps for up to this long — the window
+# a restarting master (journal replay, scheduler reschedule) needs, kept
+# deliberately distinct from retry_rpc's per-call budget.
+OUTAGE_ENV = "DLROVER_TPU_MASTER_OUTAGE_S"
+# Env ceiling for the per-call retry budget (overrides the default for
+# every wrapped verb; an explicit retry= kwarg still wins).
+MAX_RETRIES_ENV = "DLROVER_TPU_RPC_MAX_RETRIES"
+
+# "Master unreachable", as opposed to "master answered with an error":
+# socket/timeout failures are OSError subclasses, half-closed keep-alive
+# connections surface as http.client exceptions. An HTTP-level error
+# reply (RuntimeError from the stub) means the master is alive — outage
+# mode must not mask it.
+_OUTAGE_ERRORS = (OSError, http.client.HTTPException)
+
+
+class RpcRetriesExhausted(RuntimeError):
+    """Every retry attempt of one RPC verb failed (named in message)."""
+
+    def __init__(self, verb: str, attempts: int, last_error: Exception):
+        super().__init__(
+            f"RPC {verb} failed after {attempts} attempts "
+            f"(last error: {type(last_error).__name__}: {last_error})"
+        )
+        self.verb = verb
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+def _exhausted_counter():
+    from dlrover_tpu.observability.registry import default_registry
+
+    return default_registry().counter(
+        "client_rpc_retries_exhausted_total",
+        "client RPCs that failed every retry attempt, by verb",
+        labelnames=("verb",),
+    )
+
+
+def _default_retries() -> int:
+    env = os.getenv(MAX_RETRIES_ENV, "")
+    if env:
+        try:
+            return max(int(env), 1)
+        except ValueError:
+            pass
+    return JobConstant.MASTER_CLIENT_DEFAULT_RETRY
+
+
+def _outage_window_s() -> float:
+    try:
+        return float(os.getenv(OUTAGE_ENV, "0") or 0.0)
+    except ValueError:
+        return 0.0
 
 
 def retry_rpc(func):
@@ -30,6 +88,16 @@ def retry_rpc(func):
     failed together (master restart) from re-synchronizing into retry
     stampedes.
 
+    Exhaustion contract: the per-call budget (default
+    ``MASTER_CLIENT_DEFAULT_RETRY``, env-tunable via
+    ``DLROVER_TPU_RPC_MAX_RETRIES``, explicit ``retry=`` kwarg wins)
+    raises :class:`RpcRetriesExhausted` naming the verb and ticks
+    ``client_rpc_retries_exhausted_total{verb}`` — unless the failure is
+    connection-class and ``DLROVER_TPU_MASTER_OUTAGE_S`` is set, in
+    which case the client enters bounded outage mode: long jittered
+    reconnect attempts until the window expires (master crash-restart
+    ride-through, docs/DESIGN.md §37).
+
     Tracing: ONE client span covers every attempt — a retried RPC is
     the same logical operation re-sent, so the span's ``retry`` attr
     increments instead of minting sibling spans, and the server spans
@@ -38,9 +106,7 @@ def retry_rpc(func):
     """
 
     def wrapper(self, *args, **kwargs):
-        retry = max(
-            kwargs.pop("retry", JobConstant.MASTER_CLIENT_DEFAULT_RETRY), 1
-        )
+        retry = max(kwargs.pop("retry", _default_retries()), 1)
         err = None
         with tracing.span(f"rpc.{func.__name__}", kind="client") as sp:
             for i in range(retry):
@@ -52,13 +118,43 @@ def retry_rpc(func):
                     return func(self, *args, **kwargs)
                 except Exception as e:  # noqa: BLE001 — transports vary
                     err = e
+            outage_s = _outage_window_s()
+            if outage_s > 0 and isinstance(err, _OUTAGE_ERRORS):
+                deadline = time.monotonic() + outage_s
+                self._outage_begin(func.__name__, err)
+                try:
+                    while time.monotonic() < deadline:
+                        sp.inc_attr("outage_retry")
+                        remaining = deadline - time.monotonic()
+                        time.sleep(
+                            min(
+                                1.0 + random.uniform(0.0, 2.0),
+                                max(remaining, 0.05),
+                            )
+                        )
+                        try:
+                            result = func(self, *args, **kwargs)
+                            self._outage_end(recovered=True)
+                            return result
+                        except _OUTAGE_ERRORS as e:
+                            err = e
+                        except Exception as e:  # noqa: BLE001
+                            # Master is back but the verb itself errors:
+                            # surface that, don't spin the window out.
+                            err = e
+                            break
+                finally:
+                    self._outage_end(recovered=False)
             sp.set_attr("error", type(err).__name__)
             # The raise happens OUTSIDE the with block, so __exit__
             # would close this span "ok" — end it as the failure it is
             # (end() is idempotent; __exit__'s end becomes a no-op).
             sp.end(status="error")
-        logger.warning("RPC %s failed after %d tries: %s", func.__name__, retry, err)
-        raise err
+        _exhausted_counter().inc(verb=func.__name__)
+        logger.warning(
+            "RPC %s failed after %d tries: %s", func.__name__, retry, err
+        )
+        raise RpcRetriesExhausted(func.__name__, retry, err) from err
 
     return wrapper
 
@@ -79,6 +175,12 @@ class MasterClient:
         self._node_id = node_id
         self._node_type = node_type
         self._stub = build_master_stub(master_addr, kind=kind, timeout=timeout)
+        # Epoch fencing (DESIGN.md §37): last master incarnation observed
+        # in a response; -1 until a journal-backed master answers.
+        self._epoch_lock = threading.Lock()
+        self._master_epoch = -1
+        self._epoch_listeners: List[Callable[[int, int], None]] = []
+        self._in_outage = False
 
     # ---- plumbing ----------------------------------------------------------
 
@@ -93,7 +195,9 @@ class MasterClient:
             trace=tracing.current_carrier(),
         )
         resp = self._stub.get(msg, timeout=timeout)
-        return comm.BaseResponse.deserialize(resp.data)
+        out = comm.BaseResponse.deserialize(resp.data)
+        self._observe_epoch(out)
+        return out
 
     def _report(self, request: comm.BaseRequest, timeout: Optional[float] = None):
         fault_point("rpc.client.report", request=type(request).__name__)
@@ -104,7 +208,67 @@ class MasterClient:
             trace=tracing.current_carrier(),
         )
         resp = self._stub.report(msg, timeout=timeout)
-        return comm.BaseResponse.deserialize(resp.data)
+        out = comm.BaseResponse.deserialize(resp.data)
+        self._observe_epoch(out)
+        return out
+
+    # ---- epoch fencing & outage ride-through (DESIGN.md §37) ---------------
+
+    @property
+    def master_epoch(self) -> int:
+        return self._master_epoch
+
+    @property
+    def in_outage(self) -> bool:
+        return self._in_outage
+
+    def add_epoch_listener(self, fn: Callable[[int, int], None]):
+        """Register ``fn(old_epoch, new_epoch)`` — fired (on the RPC
+        thread that noticed) when a response carries a master_epoch
+        different from the last one observed. The FIRST observation only
+        records the epoch: a fresh worker joining an old master is not a
+        restart."""
+        with self._epoch_lock:
+            self._epoch_listeners.append(fn)
+
+    def _observe_epoch(self, resp):
+        epoch = getattr(resp, "master_epoch", -1)
+        if not isinstance(epoch, int) or epoch < 0:
+            return
+        listeners = []
+        with self._epoch_lock:
+            prev = self._master_epoch
+            if epoch != prev:
+                self._master_epoch = epoch
+                if prev >= 0:
+                    listeners = list(self._epoch_listeners)
+        for fn in listeners:
+            # Listener RPCs (re-register, flush) re-enter _observe_epoch
+            # with an unchanged epoch — no recursion.
+            try:
+                fn(prev, epoch)
+            except Exception:  # noqa: BLE001 — listener bugs must not kill RPCs
+                logger.warning(
+                    "master-epoch listener %s failed", fn, exc_info=True
+                )
+
+    def _outage_begin(self, verb: str, err: Exception):
+        if not self._in_outage:
+            self._in_outage = True
+            logger.warning(
+                "master unreachable on %s (%s: %s); entering outage "
+                "ride-through for up to %ss",
+                verb,
+                type(err).__name__,
+                err,
+                _outage_window_s(),
+            )
+
+    def _outage_end(self, recovered: bool):
+        if self._in_outage:
+            self._in_outage = False
+            if recovered:
+                logger.info("master reachable again; outage mode exited")
 
     def wait_master_ready(self, timeout: float = 120.0) -> bool:
         return self._stub.wait_ready(timeout)

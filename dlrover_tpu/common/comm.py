@@ -40,6 +40,13 @@ class BaseRequest(PickleSerializable):
 class BaseResponse(PickleSerializable):
     success: bool = True
     reason: str = ""
+    # Monotone incarnation of the master that produced this response,
+    # stamped by the servicer when a durable journal is armed (-1 = no
+    # journal / pre-journal build). Workers fence on a CHANGE in this
+    # value to detect a restarted master and re-register/flush
+    # (docs/DESIGN.md §37). Readers use getattr(): responses pickled by
+    # older builds carry no attribute at all.
+    master_epoch: int = -1
 
 
 # --------------------------------------------------------------------------

@@ -91,6 +91,7 @@ class DistributedJobMaster:
         autoscale_max_world: int = 0,
         autoscale_ckpt_interval_s: float = 60.0,
         autoscale_record: str = "",
+        journal_path: str = "",
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -183,16 +184,58 @@ class DistributedJobMaster:
         )
         if _tracer is not None:
             _tracer.set_on_finish(self.trace_aggregator.ingest_one)
+        # Durable control-plane journal (DESIGN.md §37). Rehydrate
+        # BEFORE the servicer is built: the servicer's replica-token
+        # seed check must see the restored token, not mint a new one.
+        from dlrover_tpu.master.elastic_training.kv_store import (
+            KVStoreService,
+        )
+        from dlrover_tpu.master.elastic_training.sync_service import (
+            SyncService,
+        )
+        from dlrover_tpu.master.journal import (
+            MasterJournal,
+            journal_path_from_env,
+            restore_master_state,
+        )
+
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.journal = None
+        jpath = journal_path or journal_path_from_env()
+        if jpath:
+            self.journal = MasterJournal(jpath)
+            restore_master_state(
+                self.journal.recovered,
+                task_manager=self.task_manager,
+                kv_store=self.kv_store,
+                rescale_coordinator=self.rescale_coordinator,
+                sync_service=self.sync_service,
+                rdzv_managers=self.rdzv_managers,
+                job_manager=self.job_manager,
+            )
+            self.rescale_coordinator.on_plan_cut = (
+                lambda plan: self.journal.append(
+                    "plan_cut", plan_id=plan.plan_id
+                )
+            )
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
             job_manager=self.job_manager,
             diagnosis_master=diagnosis_master,
             perf_monitor=self.perf_monitor,
+            sync_service=self.sync_service,
+            kv_store=self.kv_store,
             rescale_coordinator=self.rescale_coordinator,
             trace_aggregator=self.trace_aggregator,
+            journal=self.journal,
         )
         self._server = create_master_server(port, self.servicer, transport)
+        if self.journal is not None and hasattr(
+            self._server, "add_shutdown_hook"
+        ):
+            self._server.add_shutdown_hook(self.journal.close)
         self.port = self._server.port
         self._node_num = node_num
         self._stopped = threading.Event()
@@ -680,7 +723,13 @@ class DistributedJobMaster:
             self.diagnosis_master.stop_observing()
         self.task_manager.stop()
         self.job_manager.stop()
-        self._server.stop()
+        graceful = getattr(self._server, "graceful_stop", None)
+        if graceful is not None:
+            graceful()
+        else:
+            self._server.stop()
+        if self.journal is not None and not self.journal.closed:
+            self.journal.close()
 
     def request_stop(self):
         self._stopped.set()

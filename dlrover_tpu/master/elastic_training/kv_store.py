@@ -84,6 +84,11 @@ class KVStoreService:
         with self._lock:
             return len(self._store)
 
+    def dump(self) -> Dict[str, bytes]:
+        """Full copy for journal snapshot compaction (DESIGN.md §37)."""
+        with self._lock:
+            return dict(self._store)
+
     def delete(self, key: str):
         with self._lock:
             self._store.pop(key, None)

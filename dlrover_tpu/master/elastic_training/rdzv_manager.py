@@ -145,6 +145,18 @@ class RendezvousManager(ABC):
                     len(self._waiting), rdzv=self.name
                 )
 
+    def restore_committed_world(self, rdzv_round: int, world: Dict[int, int]):
+        """Master-journal rehydration (DESIGN.md §37): a restarted
+        master re-serves the last committed world at the right round so
+        riding-through workers polling ``get_comm_world`` see their own
+        world again instead of an empty round-0 — and a genuinely new
+        join still starts the next round above the journaled one."""
+        with self._lock:
+            if rdzv_round + 1 <= self._rdzv_round:
+                return
+            self._rdzv_round = rdzv_round + 1
+            self._latest_world = {int(r): int(n) for r, n in world.items()}
+
     def _record_round_completed(self):
         """Call under self._lock, right after a round's waiters moved
         into the completed world."""

@@ -146,7 +146,21 @@ class RescaleCoordinator:
         self._plan: Optional[RescalePlan] = None
         self._plan_seq = 0
         self._committed_step = -1
+        # Master-journal hook (DESIGN.md §37): called with each freshly
+        # cut plan so plan_id monotonicity survives a master crash.
+        # Invoked under self._lock — the hook must not call back in.
+        self.on_plan_cut: Optional[Callable[[RescalePlan], None]] = None
         self._m = _metrics()
+
+    def restore_journal_state(self, plan_seq: int, committed_step: int):
+        """Master-journal rehydration: floor the plan_id sequence so a
+        restarted master can never re-issue a stale plan_id, and
+        re-learn the newest committed checkpoint step."""
+        with self._lock:
+            self._plan_seq = max(self._plan_seq, int(plan_seq))
+            self._committed_step = max(
+                self._committed_step, int(committed_step)
+            )
 
     # ---- configuration -----------------------------------------------------
 
@@ -360,6 +374,11 @@ class RescaleCoordinator:
             cut_seq=self._seq,
         )
         self._m["plans"].inc(reason=reason)
+        if self.on_plan_cut is not None:
+            try:
+                self.on_plan_cut(self._plan)
+            except Exception:
+                logger.exception("on_plan_cut hook failed")
         logger.info(
             "rescale plan %d cut (%s): world=%s restore_step=%d",
             self._plan.plan_id,

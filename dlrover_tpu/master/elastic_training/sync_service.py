@@ -82,6 +82,28 @@ class SyncService:
         finally:
             self._wait_depth.dec()
 
+    def journal_snapshot(self) -> dict:
+        """Barrier membership/finish state for journal compaction
+        (DESIGN.md §37)."""
+        with self._lock:
+            return {
+                "joins": {
+                    name: sorted(ranks)
+                    for name, ranks in self._syncs.items()
+                },
+                "finished": sorted(self._finished),
+            }
+
+    def restore_journal_state(self, joins, finished):
+        """Rehydrate after a master restart: riders re-polling
+        ``wait_finished`` on an already-finished barrier must not hang
+        on the new incarnation."""
+        with self._cond:
+            for name, ranks in (joins or {}).items():
+                self._syncs.setdefault(name, set()).update(ranks)
+            self._finished.update(finished or ())
+            self._cond.notify_all()
+
     def members(self, sync_name: str) -> Set[int]:
         with self._lock:
             return set(self._syncs.get(sync_name, set()))

@@ -36,6 +36,7 @@ class LocalJobMaster:
         autoscale_dry_run: bool = False,
         autoscale_interval_s: float = 5.0,
         autoscale_record: str = "",
+        journal_path: str = "",
     ):
         self.job_name = job_name
         self._job_context = get_job_context()
@@ -52,6 +53,44 @@ class LocalJobMaster:
         self.rescale_coordinator = RescaleCoordinator(
             bootstrap_min=node_num
         )
+        # Durable control-plane journal (DESIGN.md §37). Restore order
+        # matters: kv/sync/task state is rehydrated BEFORE the servicer
+        # is constructed so its replica-token seed check sees the
+        # restored token instead of journaling a fresh (wrong) one.
+        from dlrover_tpu.master.elastic_training.kv_store import (
+            KVStoreService,
+        )
+        from dlrover_tpu.master.elastic_training.sync_service import (
+            SyncService,
+        )
+        from dlrover_tpu.master.journal import (
+            MasterJournal,
+            journal_path_from_env,
+            restore_master_state,
+        )
+
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.journal = None
+        jpath = journal_path or journal_path_from_env()
+        if jpath:
+            self.journal = MasterJournal(jpath)
+            restore_master_state(
+                self.journal.recovered,
+                task_manager=self.task_manager,
+                kv_store=self.kv_store,
+                rescale_coordinator=self.rescale_coordinator,
+                sync_service=self.sync_service,
+                rdzv_managers=self.rdzv_managers,
+                job_manager=self.job_manager,
+            )
+            # Plan cuts are journaled as they happen so a restarted
+            # master never re-issues a stale plan_id.
+            self.rescale_coordinator.on_plan_cut = (
+                lambda plan: self.journal.append(
+                    "plan_cut", plan_id=plan.plan_id
+                )
+            )
         if batch_config is not None:
             # Rendezvous and rescale plans only form worlds the trainer's
             # batch config can actually train at (global_batch divisible
@@ -82,10 +121,17 @@ class LocalJobMaster:
             job_manager=self.job_manager,
             diagnosis_master=self.diagnosis_master,
             perf_monitor=self.perf_monitor,
+            sync_service=self.sync_service,
+            kv_store=self.kv_store,
             rescale_coordinator=self.rescale_coordinator,
             trace_aggregator=self.trace_aggregator,
+            journal=self.journal,
         )
         self._server = create_master_server(port, self.servicer, transport)
+        if self.journal is not None and hasattr(
+            self._server, "add_shutdown_hook"
+        ):
+            self._server.add_shutdown_hook(self.journal.close)
         self.port = self._server.port
         self._node_num = node_num
         self._stopped = threading.Event()
@@ -247,7 +293,15 @@ class LocalJobMaster:
         self.diagnosis_master.stop_observing()
         self.task_manager.stop()
         self.job_manager.stop()
-        self._server.stop()
+        # Prefer the draining stop: finish in-flight RPCs, run shutdown
+        # hooks (journal flush+fsync+close record), sever keep-alives.
+        graceful = getattr(self._server, "graceful_stop", None)
+        if graceful is not None:
+            graceful()
+        else:
+            self._server.stop()
+        if self.journal is not None and not self.journal.closed:
+            self.journal.close()
 
     def request_stop(self):
         self._stopped.set()

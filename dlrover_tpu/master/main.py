@@ -5,10 +5,27 @@ Parity: reference dlrover/python/master/main.py. Run as
 """
 
 import os
+import signal
 import sys
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.args import parse_master_args
+
+
+def _install_sigterm(master):
+    """SIGTERM = graceful shutdown (DESIGN.md §37): the run loop exits
+    on the stop flag and stop() drains the server, runs journal
+    flush+fsync hooks, and writes the clean-shutdown close record."""
+
+    def _on_term(signum, frame):
+        logger.info("SIGTERM received: requesting graceful master stop")
+        req = getattr(master, "request_stop", None)
+        (req or master.stop)()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not on the main thread (embedded use) — caller owns signals
 
 
 def run(args) -> int:
@@ -52,6 +69,7 @@ def run(args) -> int:
             )
         master = DistributedJobMaster.from_args(args)
     master.prepare()
+    _install_sigterm(master)
     if args.port_file:
         # Publish the port before any blocking pre-check: agents need it
         # to reach the master, and the connection pre-check needs agents.

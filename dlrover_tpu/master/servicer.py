@@ -6,6 +6,7 @@ type->handler table instead of method-name reflection, so the full RPC
 surface is greppable.
 """
 
+import base64
 import time
 from typing import Dict, Optional
 
@@ -47,7 +48,27 @@ class MasterServicer(MasterService):
         rescale_coordinator=None,
         trace_aggregator=None,
         overload_governor: Optional[OverloadGovernor] = None,
+        journal=None,
     ):
+        # Durable master journal (docs/DESIGN.md §37): when present,
+        # every state transition that must survive a master crash is
+        # appended BEFORE the reply leaves, and its master_epoch is
+        # stamped into every response for worker-side fencing.
+        self._journal = journal
+        self._master_epoch = (
+            journal.master_epoch if journal is not None else -1
+        )
+        self._dataset_params: Dict[str, dict] = {}
+        self._journal_rdzv: Dict[str, dict] = {}
+        if journal is not None and journal.recovered is not None:
+            for name, replay in journal.recovered.datasets.items():
+                self._dataset_params[name] = dict(replay.params)
+            self._journal_rdzv = {
+                name: dict(committed)
+                for name, committed in journal.recovered.rdzv.items()
+            }
+            if getattr(journal, "_snapshot_fn", None) is None:
+                journal._snapshot_fn = self.journal_snapshot
         self._rescale_coordinator = rescale_coordinator
         # Recent trace trees served at /api/traces: fed by workers
         # pushing drained spans over DiagnosisDataReport and by the
@@ -71,10 +92,13 @@ class MasterServicer(MasterService):
         if not self._kv_store.get(CheckpointConstant.REPLICA_TOKEN_KEY):
             import secrets
 
+            token = secrets.token_hex(16).encode()
             self._kv_store.set(
-                CheckpointConstant.REPLICA_TOKEN_KEY,
-                secrets.token_hex(16).encode(),
+                CheckpointConstant.REPLICA_TOKEN_KEY, token
             )
+            # Journal the seed so the token survives a master restart —
+            # agents that cached it mid-job must keep matching.
+            self._journal_kv_set(CheckpointConstant.REPLICA_TOKEN_KEY, token)
         self._job_metric_collector = job_metric_collector
         self._elastic_ps_service = elastic_ps_service or ClusterVersionService()
         self._pre_check_status = PreCheckStatus.PASS
@@ -253,6 +277,14 @@ class MasterServicer(MasterService):
                             handler_s, tm.inflight_now()
                         )
                 ts0 = time.monotonic()
+                if self._master_epoch >= 0:
+                    # Epoch fencing (§37): every response carries the
+                    # journal's monotone master_epoch so a worker can
+                    # tell a restarted master from the one it knew.
+                    try:
+                        response.master_epoch = self._master_epoch
+                    except (AttributeError, TypeError):
+                        pass
                 reply = Message(
                     node_id=message.node_id, data=response.serialize()
                 )
@@ -292,6 +324,8 @@ class MasterServicer(MasterService):
                 "occupancy": size(),
                 "drops": 0,  # unbounded dict today; 0 by definition
             }
+        if self._journal is not None:
+            buffers["journal"] = self._journal.stats()
         return {
             "overload": self._overload.state(),
             "rpc": self._telemetry.summary(),
@@ -299,6 +333,93 @@ class MasterServicer(MasterService):
             "nodes_seen": len(self._node_last_contact),
             "uptime_s": round(time.time() - self._start_time, 3),
         }
+
+    # ---- journal hooks (docs/DESIGN.md §37) -------------------------------
+
+    @property
+    def master_epoch(self) -> int:
+        return self._master_epoch
+
+    def _journal_kv_set(self, key: str, value: bytes):
+        if self._journal is not None:
+            self._journal.append(
+                "kv_set",
+                key=key,
+                val=base64.b64encode(value).decode("ascii"),
+            )
+
+    def _journal_dispatch(self, node_id: int, tasks):
+        """One group commit covering every real lease in the batch; the
+        WAL order is mutate → journal → reply, so both crash windows
+        keep exactly-once (pre-journal: the worker never got the reply
+        and the shard is regenerated; post-journal: the lease replays
+        into ``doing`` and either the rider's done-report pops it or
+        timeout recovery re-queues it)."""
+        if self._journal is None:
+            return
+        recs = [
+            {
+                "kind": "dispatch",
+                "ds": t.dataset_name,
+                "tid": t.task_id,
+                "node": node_id,
+                "epoch": t.epoch,
+                "start": t.start,
+                "end": t.end,
+                "idx": t.record_indices,
+                "part": t.partition,
+            }
+            for t in tasks
+            if t.task_id >= 0
+        ]
+        if recs:
+            self._journal.append_many(recs)
+
+    def journal_snapshot(self) -> dict:
+        """Lease-preserving full-state snapshot for journal compaction
+        (original task ids survive, so compaction never breaks the
+        exactly-once law). Reads each component under its own lock; the
+        coordinator counters are read lock-free (monotone ints)."""
+        snap: Dict[str, object] = {
+            "datasets": {},
+            "kv": {},
+            "ckpt_step": -1,
+            "plan_seq": 0,
+            "rdzv": {
+                name: {
+                    "round": committed.get("round", 0),
+                    "world": {
+                        str(r): n
+                        for r, n in (committed.get("world") or {}).items()
+                    },
+                }
+                for name, committed in self._journal_rdzv.items()
+            },
+            "sync": {},
+        }
+        if self._task_manager is not None:
+            snapshots = getattr(
+                self._task_manager, "journal_snapshots", None
+            )
+            if callable(snapshots):
+                for name, per in snapshots().items():
+                    entry = dict(per)
+                    entry["params"] = self._dataset_params.get(name, {})
+                    snap["datasets"][name] = entry
+        dump = getattr(self._kv_store, "dump", None)
+        if callable(dump):
+            snap["kv"] = {
+                k: base64.b64encode(v).decode("ascii")
+                for k, v in dump().items()
+            }
+        coord = self._rescale_coordinator
+        if coord is not None:
+            snap["plan_seq"] = int(getattr(coord, "_plan_seq", 0))
+            snap["ckpt_step"] = int(getattr(coord, "_committed_step", -1))
+        sync_snap = getattr(self._sync_service, "journal_snapshot", None)
+        if callable(sync_snap):
+            snap["sync"] = sync_snap()
+        return snap
 
     # ---- rendezvous --------------------------------------------------------
 
@@ -329,6 +450,17 @@ class MasterServicer(MasterService):
             rdzv_round, group, world = mgr.get_comm_world(req.node_id)
             node_groups = {}
         rank_order = list(world)
+        if self._journal is not None and world:
+            last = self._journal_rdzv.get(req.rdzv_name, {})
+            if last.get("round") != rdzv_round:
+                committed = {"round": rdzv_round, "world": dict(world)}
+                self._journal_rdzv[req.rdzv_name] = committed
+                self._journal.append(
+                    "rdzv",
+                    name=req.rdzv_name,
+                    round=rdzv_round,
+                    world={str(r): n for r, n in world.items()},
+                )
         return comm.CommWorld(
             round=rdzv_round,
             group=group,
@@ -517,6 +649,9 @@ class MasterServicer(MasterService):
     # ---- kv store ----------------------------------------------------------
 
     def _kv_set(self, msg, req: comm.KVStoreSetRequest):
+        # Journal BEFORE apply: a crash in between replays the set and
+        # the client's retry re-applies it idempotently.
+        self._journal_kv_set(req.key, req.value)
         self._kv_store.set(req.key, req.value)
         return comm.BaseResponse(True)
 
@@ -524,9 +659,13 @@ class MasterServicer(MasterService):
         return comm.KVStoreGetResponse(value=self._kv_store.get(req.key))
 
     def _kv_add(self, msg, req: comm.KVStoreAddRequest):
-        return comm.KVStoreAddResponse(
-            value=self._kv_store.add(req.key, req.delta)
-        )
+        # Apply-then-journal the RESULT (not the delta): kv_add is the
+        # one deliberately unretried verb, so replaying the final value
+        # can never double-count an increment (§37: a crash before the
+        # journal write loses the add, and the client sees the error).
+        value = self._kv_store.add(req.key, req.delta)
+        self._journal_kv_set(req.key, str(value).encode())
+        return comm.KVStoreAddResponse(value=value)
 
     def _kv_multi_get(self, msg, req: comm.KVStoreMultiGetRequest):
         return comm.KVStoreMultiGetResponse(
@@ -536,10 +675,16 @@ class MasterServicer(MasterService):
     # ---- sync --------------------------------------------------------------
 
     def _sync_join(self, msg, req: comm.SyncJoinRequest):
+        if self._journal is not None:
+            self._journal.append(
+                "sync", name=req.sync_name, op="join", rank=req.node_rank
+            )
         self._sync_service.join_sync(req.sync_name, req.node_rank)
         return comm.BaseResponse(True)
 
     def _sync_finish(self, msg, req: comm.SyncFinishRequest):
+        if self._journal is not None:
+            self._journal.append("sync", name=req.sync_name, op="finish")
         self._sync_service.sync_finished(req.sync_name)
         return comm.BaseResponse(True)
 
@@ -550,13 +695,25 @@ class MasterServicer(MasterService):
 
     def _report_dataset_params(self, msg, req: comm.DatasetShardParams):
         if self._task_manager is not None:
+            params = {
+                f: getattr(req, f)
+                for f in comm.DatasetShardParams.__dataclass_fields__
+            }
+            self._dataset_params[req.dataset_name] = params
+            if (
+                self._journal is not None
+                and self._task_manager.get_dataset(req.dataset_name) is None
+            ):
+                self._journal.append("dataset", params=params)
             self._task_manager.new_dataset(req)
         return comm.BaseResponse(True)
 
     def _get_task(self, msg, req: comm.TaskRequest):
         if self._task_manager is None:
             return comm.ShardTask()
-        return self._task_manager.get_task(req.node_id, req.dataset_name)
+        task = self._task_manager.get_task(req.node_id, req.dataset_name)
+        self._journal_dispatch(req.node_id, [task])
+        return task
 
     def _get_tasks(self, msg, req: comm.MultiTaskRequest):
         if self._task_manager is None:
@@ -564,6 +721,7 @@ class MasterServicer(MasterService):
         tasks = self._task_manager.get_tasks(
             req.node_id, req.dataset_name, req.count
         )
+        self._journal_dispatch(req.node_id, tasks)
         wait = bool(tasks) and tasks[0].task_type == TaskType.WAIT
         return comm.MultiTaskResponse(
             tasks=[] if wait else [t for t in tasks if t.task_id >= 0],
@@ -572,6 +730,17 @@ class MasterServicer(MasterService):
 
     def _report_task_done(self, msg, req: comm.TaskDoneReport):
         if self._task_manager is not None:
+            # Journal-first: losing an applied-but-unjournaled done
+            # would re-queue a consumed shard on restart (double read);
+            # replaying a journaled-but-unapplied done is idempotent.
+            if self._journal is not None and req.task_id >= 0:
+                self._journal.append(
+                    "done",
+                    ds=req.dataset_name,
+                    node=req.node_id,
+                    ok=[req.task_id] if req.success else [],
+                    fail=[] if req.success else [req.task_id],
+                )
             self._task_manager.report_task_done(
                 req.dataset_name, req.task_id, req.node_id, req.success
             )
@@ -579,6 +748,16 @@ class MasterServicer(MasterService):
 
     def _report_tasks_done_batch(self, msg, req: comm.TaskDoneBatchReport):
         if self._task_manager is not None:
+            if self._journal is not None and (
+                req.done_ids or req.failed_ids
+            ):
+                self._journal.append(
+                    "done",
+                    ds=req.dataset_name,
+                    node=req.node_id,
+                    ok=list(req.done_ids),
+                    fail=list(req.failed_ids or []),
+                )
             self._task_manager.report_tasks_done(
                 req.dataset_name, req.node_id, req.done_ids, req.failed_ids
             )
@@ -594,6 +773,10 @@ class MasterServicer(MasterService):
         self, msg, req: comm.ShardCheckpointRestoreRequest
     ):
         if self._task_manager is not None:
+            if self._journal is not None and req.checkpoint:
+                self._journal.append(
+                    "shard_ckpt", ds=req.dataset_name, ckpt=req.checkpoint
+                )
             self._task_manager.restore_shard_checkpoint(
                 req.dataset_name, req.checkpoint
             )
@@ -609,6 +792,10 @@ class MasterServicer(MasterService):
             # rescale plan's restore_step works without a job manager
             # (soak harness, standalone masters).
             self._rescale_coordinator.note_ckpt_step(req.step, req.committed)
+        if self._journal is not None and req.committed:
+            # Only committed steps matter to a restarted master (the
+            # monotone frontier a rescale plan's restore_step obeys).
+            self._journal.append("ckpt_step", step=req.step)
         return comm.BaseResponse(True)
 
     def _get_ckpt_latest_step(self, msg, req):

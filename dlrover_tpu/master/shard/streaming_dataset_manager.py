@@ -190,3 +190,76 @@ class StreamingDatasetManager:
                     )
                 )
                 self._task_id_seq += 1
+
+    # ---- master-journal crash recovery (docs/DESIGN.md §37) ---------------
+
+    def rehydrate(
+        self,
+        dataset_name: str,
+        epoch: int,
+        completed: int,
+        todo_shards,
+        doing,
+        next_task_id: int,
+        splitter_ckpt: dict = None,
+    ):
+        """Install journal-replayed state after a master crash: splitter
+        offsets advance past every journaled carve, outstanding leases
+        keep their ORIGINAL task ids (same exactly-once law as
+        ``BatchDatasetManager.rehydrate``). ``epoch`` is ignored —
+        per-partition offsets, not epochs, are streaming progress."""
+        with self._lock:
+            self.todo.clear()
+            self.doing.clear()
+            if splitter_ckpt:
+                self._splitter.restore_checkpoint(splitter_ckpt)
+            self._completed_count = completed
+            self._task_id_seq = max(next_task_id, 0)
+            for entry in todo_shards:
+                start, end = entry[0], entry[1]
+                part = entry[3] if len(entry) > 3 else 0
+                self.todo.append(
+                    Task(
+                        self._task_id_seq,
+                        self._task_type,
+                        Shard(dataset_name, start, end, partition=part),
+                    )
+                )
+                self._task_id_seq += 1
+            now = time.time()
+            for tid, lease in doing.items():
+                node_id, task_epoch, start, end, _indices, part = lease
+                task = Task(
+                    tid,
+                    self._task_type,
+                    Shard(dataset_name, start, end, partition=part),
+                    task_epoch,
+                )
+                self.doing[tid] = _DoingTask(task, node_id, now)
+                self._task_id_seq = max(self._task_id_seq, tid + 1)
+
+    def journal_snapshot(self) -> dict:
+        """Lease-preserving state for journal compaction (ids survive,
+        unlike :meth:`checkpoint` which folds doing into undone)."""
+        with self._lock:
+            return {
+                "epoch": 0,
+                "completed": self._completed_count,
+                "splitter": self._splitter.to_checkpoint(),
+                "todo": [
+                    [t.shard.start, t.shard.end, None, t.shard.partition]
+                    for t in self.todo
+                ],
+                "doing": {
+                    tid: {
+                        "node": d.node_id,
+                        "epoch": d.task.epoch,
+                        "start": d.task.shard.start,
+                        "end": d.task.shard.end,
+                        "idx": None,
+                        "part": d.task.shard.partition,
+                    }
+                    for tid, d in self.doing.items()
+                },
+                "next_tid": self._task_id_seq,
+            }

@@ -247,6 +247,91 @@ class BatchDatasetManager:
                 )
                 self._task_id_seq += 1
 
+    # ---- master-journal crash recovery (docs/DESIGN.md §37) ---------------
+
+    def rehydrate(
+        self,
+        dataset_name: str,
+        epoch: int,
+        completed: int,
+        todo_shards,
+        doing,
+        next_task_id: int,
+    ):
+        """Install journal-replayed state after a master crash. Unlike
+        :meth:`restore` (a user-driven shard-checkpoint restore that
+        mints fresh ids), crash rehydration must keep outstanding
+        leases in ``doing`` under their ORIGINAL task ids so a worker
+        that rode through the outage can still report them done —
+        re-queueing them with new ids would double-dispatch their data.
+
+        ``todo_shards``: iterable of ``[start, end, indices, partition]``.
+        ``doing``: ``tid -> (node_id, epoch, start, end, indices, part)``.
+        """
+        with self._lock:
+            self.todo.clear()
+            self.doing.clear()
+            self._splitter.epoch = max(epoch, 0)
+            self._completed_count = completed
+            self._task_id_seq = max(next_task_id, 0)
+            now = time.time()
+            for entry in todo_shards:
+                start, end = entry[0], entry[1]
+                indices = entry[2] if len(entry) > 2 else None
+                part = entry[3] if len(entry) > 3 else 0
+                self.todo.append(
+                    Task(
+                        self._task_id_seq,
+                        self._task_type,
+                        Shard(dataset_name, start, end, indices, part),
+                        self._splitter.epoch,
+                        enqueue_ts=now,
+                    )
+                )
+                self._task_id_seq += 1
+            for tid, lease in doing.items():
+                node_id, task_epoch, start, end, indices, part = lease
+                task = Task(
+                    tid,
+                    self._task_type,
+                    Shard(dataset_name, start, end, indices, part),
+                    task_epoch,
+                    enqueue_ts=now,
+                )
+                # start_time = now: a dead worker's rehydrated lease
+                # re-queues via the normal timeout path; a live worker
+                # pops it with a done-report long before that.
+                self.doing[tid] = _DoingTask(task, node_id, now)
+                self._task_id_seq = max(self._task_id_seq, tid + 1)
+
+    def journal_snapshot(self) -> dict:
+        """Lease-preserving state for journal compaction. Unlike
+        :meth:`checkpoint` this does NOT fold ``doing`` into the undone
+        list — outstanding leases keep their ids across the snapshot so
+        compaction never breaks the exactly-once law above."""
+        with self._lock:
+            return {
+                "epoch": self._splitter.epoch,
+                "completed": self._completed_count,
+                "todo": [
+                    [t.shard.start, t.shard.end, t.shard.record_indices,
+                     t.shard.partition]
+                    for t in self.todo
+                ],
+                "doing": {
+                    tid: {
+                        "node": d.node_id,
+                        "epoch": d.task.epoch,
+                        "start": d.task.shard.start,
+                        "end": d.task.shard.end,
+                        "idx": d.task.shard.record_indices,
+                        "part": d.task.shard.partition,
+                    }
+                    for tid, d in self.doing.items()
+                },
+                "next_tid": self._task_id_seq,
+            }
+
 
 class TaskManager:
     """Owns all dataset managers; periodic timeout recovery thread.
@@ -455,6 +540,18 @@ class TaskManager:
             if not self._datasets:
                 return False
             return all(m.completed() for m in self._datasets.values())
+
+    def journal_snapshots(self) -> Dict[str, dict]:
+        """Per-dataset lease-preserving snapshots for journal compaction
+        (managers without the surface are skipped)."""
+        with self._lock:
+            datasets = dict(self._datasets)
+        out: Dict[str, dict] = {}
+        for name, mgr in datasets.items():
+            snap = getattr(mgr, "journal_snapshot", None)
+            if snap is not None:
+                out[name] = snap()
+        return out
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         mgr = self.get_dataset(dataset_name)

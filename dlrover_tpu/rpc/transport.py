@@ -14,11 +14,13 @@ servicer.py:994 HttpMasterServicer).
 import abc
 import http.client
 import os
+import signal
+import socket
 import threading
 import time
 from concurrent import futures
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, List, Optional
 
 import grpc
 
@@ -217,6 +219,34 @@ class _FleetHTTPServer(ThreadingHTTPServer):
     # somaxconn clamp.
     request_queue_size = 128
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._open_conns: set = set()
+        self._conns_mu = threading.Lock()
+
+    def process_request_thread(self, request, client_address):
+        with self._conns_mu:
+            self._open_conns.add(request)
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._conns_mu:
+                self._open_conns.discard(request)
+
+    def close_open_connections(self):
+        """Sever established keep-alive connections. shutdown() only
+        stops the accept loop — handler threads parked on persistent
+        client connections would otherwise keep answering for a stopped
+        master generation (epoch fencing, DESIGN.md §37: a stub must
+        fail over to the restarted master, not a zombie thread)."""
+        with self._conns_mu:
+            conns = list(self._open_conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
 
 class HttpMasterServer:
     def __init__(self, port: int, service: MasterService):
@@ -224,6 +254,9 @@ class HttpMasterServer:
         self._httpd = _FleetHTTPServer(("", port), handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._service = service
+        self._shutdown_hooks: List[Callable[[], None]] = []
+        self._stopped = False
 
     def start(self):
         self._thread = threading.Thread(
@@ -231,8 +264,68 @@ class HttpMasterServer:
         )
         self._thread.start()
 
-    def stop(self, grace: float = 1.0):
+    def add_shutdown_hook(self, fn: Callable[[], None]):
+        """Run ``fn`` during graceful_stop AFTER in-flight requests have
+        drained — the journal flush/close hook goes here so the last
+        handled verb's records are durable before the process exits."""
+        self._shutdown_hooks.append(fn)
+
+    def graceful_stop(self, drain_s: float = 5.0):
+        """SIGTERM-quality shutdown (DESIGN.md §37): stop accepting new
+        connections, wait (bounded) for in-flight handlers to drain,
+        then run shutdown hooks (journal flush+fsync) and close. Idem-
+        potent; plain stop() remains the abrupt path."""
+        if self._stopped:
+            return
+        self._stopped = True
+        # shutdown() stops the accept loop; handler threads already
+        # spawned by ThreadingHTTPServer keep running their request.
         self._httpd.shutdown()
+        inflight = getattr(
+            getattr(self._service, "telemetry", None), "inflight_now", None
+        )
+        if callable(inflight):
+            deadline = time.monotonic() + max(drain_s, 0.0)
+            while inflight() > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            left = inflight()
+            if left:
+                logger.warning(
+                    "graceful stop: %d RPCs still in flight after %.1fs "
+                    "drain window",
+                    left,
+                    drain_s,
+                )
+        for hook in self._shutdown_hooks:
+            try:
+                hook()
+            except Exception:
+                logger.exception("shutdown hook %s failed", hook)
+        self._httpd.close_open_connections()
+        self._httpd.server_close()
+
+    def install_sigterm_handler(self, drain_s: float = 5.0):
+        """Route SIGTERM to graceful_stop (main thread only; signal
+        module refuses elsewhere). Chains to any previous handler so
+        process-level cleanup still runs."""
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            self.graceful_stop(drain_s=drain_s)
+            if callable(prev) and prev not in (
+                signal.SIG_IGN,
+                signal.SIG_DFL,
+            ):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    def stop(self, grace: float = 1.0):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._httpd.shutdown()
+        self._httpd.close_open_connections()
         self._httpd.server_close()
 
 

@@ -78,6 +78,14 @@ EPISODE_KINDS = (
     # (delegated to dlrover_tpu/testing/fleet_soak.py). Appended so
     # episodes 0-5 keep their (seed, episode) -> plan identity.
     "kill_during_migration",
+    # Episode 7: the MASTER is SIGKILLed between a journaled shard
+    # dispatch and its reply (the master.journal.write fault point,
+    # kind=dispatch), restarted from its durable journal, and the
+    # never-restarted worker must ride the outage out and finish with
+    # exactly-once accounting (delegated to
+    # dlrover_tpu/testing/master_kill_soak.py). Appended so episodes
+    # 0-6 keep their (seed, episode) -> plan identity.
+    "master_kill",
 )
 
 
@@ -112,6 +120,9 @@ class EpisodePlan:
     # rescale episode (worker_schedules stays per-generation for the
     # single-worker kinds).
     rank_schedules: Dict[int, FaultSchedule] = field(default_factory=dict)
+    # master_kill only: SIGKILL the master on the Nth journaled
+    # dispatch record (the master.journal.write fault point).
+    master_kill_nth: int = 0
 
 
 def build_episode_plan(
@@ -218,6 +229,15 @@ def build_episode_plan(
         # exercises timeout-prune, source fallback and the
         # migration-probed breaker walk.
         pass
+    elif kind == "master_kill":
+        # The master dies on its Nth journaled dispatch — deep enough
+        # in that the worker holds live leases and at least one
+        # checkpoint interval has persisted, low enough that the 32ish
+        # dispatches of the default dataset still reach it even before
+        # any timeout-requeue redispatches.
+        plan.master_kill_nth = rng.randint(
+            2 * every + 2, max(2 * every + 2, (2 * total_steps) // 3)
+        )
     elif kind == "kill_during_rescale":
         # Rank 1 dies mid-step (cuts the scale-down plan); rank 0 is
         # SIGKILLed in the restore-to-first-step window of THAT plan
@@ -536,6 +556,10 @@ def run_episode(seed: int, episode: int, cfg: SoakConfig,
         )
     if plan.kind == "straggler_evict":
         return _run_autoscale_kind(seed, episode, cfg)
+    if plan.kind == "master_kill":
+        return _run_master_kill_kind(
+            seed, episode, plan, cfg, work_dir, artifact_dir
+        )
     ep_dir = os.path.join(work_dir, f"soak-s{seed}-e{episode}")
     shutil.rmtree(ep_dir, ignore_errors=True)
     os.makedirs(os.path.join(ep_dir, "flight"), exist_ok=True)
@@ -814,6 +838,22 @@ def _run_migration_kind(seed, episode, plan, cfg, work_dir,
             file=sys.stderr, flush=True,
         )
         raise
+
+
+def _run_master_kill_kind(seed, episode, plan, cfg, work_dir,
+                          artifact_dir):
+    """Episode kind 7 (master_kill): delegate to the control-plane
+    crash-recovery harness — the master subprocess is SIGKILLed between
+    a journaled dispatch and its reply, restarted from the journal, and
+    the never-restarted worker must finish with exactly-once accounting
+    (docs/DESIGN.md §37). The report is already soak-shaped."""
+    from dlrover_tpu.testing.master_kill_soak import (
+        run_master_kill_episode,
+    )
+
+    return run_master_kill_episode(
+        seed, episode, plan, cfg, work_dir, artifact_dir
+    )
 
 
 def _run_autoscale_kind(seed, episode, cfg):

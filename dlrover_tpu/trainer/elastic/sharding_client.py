@@ -115,7 +115,11 @@ class ShardingClient:
         # "someone else's flush is still in flight" (lock order:
         # _flush_lock -> _report_lock, never the reverse).
         self._report_lock = threading.Lock()
-        self._flush_lock = threading.Lock()
+        # RLock: the master-epoch listener fires on the RPC thread, so a
+        # flush whose own RPC observes a new master incarnation re-enters
+        # flush_reports from inside the lock; cross-thread exclusion (the
+        # "flushed means FLUSHED" guarantee) is unchanged.
+        self._flush_lock = threading.RLock()
         self._pending_done: List[int] = []
         self._pending_failed: List[int] = []
         self._pending_since = 0.0
@@ -125,16 +129,23 @@ class ShardingClient:
         self._recorder = active_recorder()
         # Idempotent on the master: every worker reports the params, the
         # first one creates the dataset.
-        self._client.report_dataset_shard_params(
-            comm.DatasetShardParams(
-                dataset_name=dataset_name,
-                dataset_size=dataset_size,
-                shard_size=shard_size,
-                num_epochs=num_epochs,
-                shuffle=shuffle,
-                task_type=task_type,
-            )
+        self._shard_params = comm.DatasetShardParams(
+            dataset_name=dataset_name,
+            dataset_size=dataset_size,
+            shard_size=shard_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            task_type=task_type,
         )
+        self._client.report_dataset_shard_params(self._shard_params)
+        # Master crash ride-through (DESIGN.md §37): when the client
+        # observes a new master incarnation, re-register the dataset
+        # params (no-op if the journal already rehydrated it) and flush
+        # coalesced done-reports so exactly-once accounting re-converges
+        # on the new epoch without restarting the prefetcher.
+        add_listener = getattr(master_client, "add_epoch_listener", None)
+        if callable(add_listener):
+            add_listener(self._on_master_epoch_change)
 
     # ---- prefetcher --------------------------------------------------------
 
@@ -460,6 +471,29 @@ class ShardingClient:
         self._metrics["report_rpcs"].inc()
         self._metrics["rpcs_saved"].inc(n - 1)
         return n
+
+    def _on_master_epoch_change(self, old_epoch: int, new_epoch: int):
+        """Runs on the RPC thread that first reached the restarted
+        master. Re-registering is idempotent (journal rehydration already
+        recreated the dataset; a params report for an existing name is a
+        no-op) and the flush drains done-reports coalesced during the
+        outage so the new incarnation's ledger converges."""
+        logger.info(
+            "master epoch %d -> %d; re-registering dataset %s and "
+            "flushing %s",
+            old_epoch,
+            new_epoch,
+            self.dataset_name,
+            "pending done-reports",
+        )
+        try:
+            self._client.report_dataset_shard_params(self._shard_params)
+        except Exception:  # noqa: BLE001 — prefetcher keeps retrying anyway
+            logger.warning(
+                "dataset re-register after master restart failed",
+                exc_info=True,
+            )
+        self.flush_reports()
 
     def _flush_if_due(self):
         with self._report_lock:

@@ -90,3 +90,10 @@ def pytest_configure(config):
         "over both serving engines, accept-law parity, int8 "
         "bit-stability) — docs/DESIGN.md §35",
     )
+    config.addinivalue_line(
+        "markers",
+        "master_recovery: control-plane crash recovery (durable master "
+        "journal WAL, epoch-fenced worker ride-through, exactly-once "
+        "rehydration) — docs/DESIGN.md §37; the master_kill soak "
+        "episode itself is slow-lane",
+    )

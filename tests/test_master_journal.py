@@ -1,0 +1,397 @@
+"""Control-plane crash recovery: durable master journal, epoch-fenced
+ride-through, retry exhaustion (docs/DESIGN.md §37).
+
+Covers the WAL durability edges the master_kill soak episode cannot
+isolate: torn-final-line repair, crash-during-compaction (old segment
+wins), future-schema-version refusal, group-commit thread safety —
+plus exactly-once TaskManager rehydration, client epoch fencing /
+outage ride-through over the real HTTP transport, and the graceful
+SIGTERM drain flushing a clean-shutdown record.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import (
+    MAX_RETRIES_ENV,
+    OUTAGE_ENV,
+    MasterClient,
+    RpcRetriesExhausted,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.elastic_training.kv_store import KVStoreService
+from dlrover_tpu.master.elastic_training.sync_service import SyncService
+from dlrover_tpu.master.journal import (
+    SCHEMA_VERSION,
+    MasterJournal,
+    load_journal,
+    restore_master_state,
+)
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.observability.registry import default_registry
+from dlrover_tpu.rpc.transport import HttpMasterServer
+
+pytestmark = pytest.mark.master_recovery
+
+
+def _params(name="ds", size=64, shard=16, epochs=1, shuffle=False):
+    return {
+        "dataset_name": name,
+        "dataset_size": size,
+        "shard_size": shard,
+        "num_epochs": epochs,
+        "shuffle": shuffle,
+        "task_type": "training",
+        "storage_type": "text",
+    }
+
+
+def _journal_with_leases(path, done_tids=(0,), outstanding_tids=(1, 2)):
+    """A journal recording a 4-shard dataset with some leases done and
+    some outstanding — the canonical crash state."""
+    j = MasterJournal(path)
+    j.append("dataset", params=_params())
+    for tid in sorted(set(done_tids) | set(outstanding_tids)):
+        j.append(
+            "dispatch", ds="ds", tid=tid, node=0, epoch=1,
+            start=tid * 16, end=(tid + 1) * 16,
+            idx=list(range(tid * 16, (tid + 1) * 16)), part=0,
+        )
+    if done_tids:
+        j.append("done", ds="ds", node=0, ok=list(done_tids), fail=[])
+    return j
+
+
+class TestJournalDurability:
+    def test_roundtrip_and_epoch_bump(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        j = _journal_with_leases(path)
+        assert j.master_epoch == 1
+        j.append("kv_set", key="rdzv/token", val="dG9r")
+        j.append("ckpt_step", step=400)
+        j.append("plan_cut", plan_id=3)
+        j.close()
+
+        j2 = MasterJournal(path)
+        assert j2.master_epoch == 2  # monotone fencing epoch
+        st = j2.recovered
+        assert st.clean_shutdown
+        assert st.corrupt_lines == 0
+        assert st.ckpt_step == 400
+        assert st.plan_seq == 3
+        assert st.kv["rdzv/token"] == b"tok"
+        ds = st.datasets["ds"]
+        assert sorted(ds.outstanding) == [1, 2]
+        assert ds.completed == 1
+        j2.close()
+
+    def test_torn_final_line_repaired_and_counted(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        j = _journal_with_leases(path)
+        # SIGKILL mid-append: a partial record with no newline.
+        j._f.write('{"kind": "done", "ds": "ds", "ok": [1')  # noqa: SLF001
+        j._f.flush()  # noqa: SLF001
+        os.fsync(j._f.fileno())  # noqa: SLF001
+        j._f.close()  # noqa: SLF001
+
+        j2 = MasterJournal(path)
+        st = j2.recovered
+        # The torn line is skipped (counted for forensics), the done it
+        # would have recorded never happened: tid 1 stays outstanding.
+        assert st.corrupt_lines == 1
+        assert not st.clean_shutdown
+        assert sorted(st.datasets["ds"].outstanding) == [1, 2]
+        # New appends land on a fresh line, not glued to torn bytes.
+        j2.append("ckpt_step", step=7)
+        j2.close()
+        st3 = load_journal(path)
+        assert st3.corrupt_lines == 1
+        assert st3.ckpt_step == 7
+        j2.close()
+
+    def test_future_schema_version_refused(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"kind": "header", "v": SCHEMA_VERSION + 1, "epoch": 9}
+            ) + "\n")
+        with pytest.raises(ValueError, match="newer than supported"):
+            MasterJournal(path)
+        # The refusing reader must not have truncated or rewritten it.
+        with open(path, encoding="utf-8") as f:
+            assert f"\"v\": {SCHEMA_VERSION + 1}" in f.read()
+
+    def test_crash_during_compaction_old_segment_wins(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        j = _journal_with_leases(path)
+        j.close()
+        before = load_journal(path)
+        # Crash AFTER the snapshot tmp was written+fsynced but BEFORE
+        # os.replace: the tmp sibling exists, the live segment is still
+        # the old journal, and recovery must read the old segment.
+        with open(path + ".compact.tmp", "w", encoding="utf-8") as f:
+            f.write(json.dumps({"kind": "header", "v": SCHEMA_VERSION,
+                                "epoch": 99, "compaction": 1}) + "\n")
+            f.write(json.dumps({"kind": "snapshot", "v": SCHEMA_VERSION,
+                                "state": {}}) + "\n")
+        j2 = MasterJournal(path)
+        assert j2.recovered.records == before.records
+        assert j2.master_epoch == before.master_epoch + 1
+        assert sorted(j2.recovered.datasets["ds"].outstanding) == [1, 2]
+        j2.close()
+
+    def test_compaction_preserves_leases_and_keeps_forensic_segment(
+        self, tmp_path
+    ):
+        path = str(tmp_path / "m.journal")
+        tm = TaskManager(task_timeout=600.0)
+        _journal_with_leases(path).close()
+        j = MasterJournal(path)
+        restore_master_state(j.recovered, task_manager=tm)
+        servicer = MasterServicer(
+            rdzv_managers={}, task_manager=tm,
+            perf_monitor=PerfMonitor(), journal=j,
+        )
+        # Lease-preserving snapshot compaction: original tids survive.
+        j.compact(servicer.journal_snapshot())
+        assert os.path.exists(path + ".1")  # forensic chain
+        j.close()
+        st = load_journal(path)
+        assert st.compactions == 1
+        assert st.clean_shutdown
+        assert sorted(st.datasets["ds"].outstanding) == [1, 2]
+        assert st.datasets["ds"].completed == 1
+        tm.stop()
+
+    def test_group_commit_concurrent_appenders(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        j = MasterJournal(path)
+        n_threads, per_thread = 8, 25
+
+        def appender(t):
+            for i in range(per_thread):
+                j.append("ckpt_step", step=t * 1000 + i)
+
+        threads = [
+            threading.Thread(target=appender, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        j.close()
+        st = load_journal(path)
+        assert st.corrupt_lines == 0
+        assert st.kinds["ckpt_step"] == n_threads * per_thread
+        # Group commit must have shared fsyncs across appenders.
+        assert j.stats()["commit_groups"] <= n_threads * per_thread
+
+
+class TestRehydration:
+    def test_exactly_once_after_restart(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        _journal_with_leases(path, done_tids=(0,),
+                             outstanding_tids=(1, 2)).close()
+        j = MasterJournal(path)
+        tm = TaskManager(task_timeout=600.0)
+        summary = restore_master_state(j.recovered, task_manager=tm)
+        assert summary["datasets"]["ds"] == {
+            "todo": 1, "doing": 2, "completed": 1, "epoch": 1,
+        }
+        mgr = tm.get_dataset("ds")
+        # Outstanding leases keep their ORIGINAL ids so a riding-through
+        # worker's done-report still pops them.
+        assert sorted(mgr.doing) == [1, 2]
+        # Drain everything: the only new dispatch is the one un-issued
+        # shard; done shard 0 is never re-dispatched.
+        task = tm.get_task(0, "ds")
+        assert (task.start, task.end) == (48, 64)
+        assert task.task_id == 3  # next_task_id = max_tid + 1
+        for tid in (1, 2, task.task_id):
+            tm.report_task_done("ds", tid, 0, True)
+        assert tm.get_task(0, "ds").task_id == -1  # exhausted
+        assert mgr._completed_count == 4  # noqa: SLF001
+        j.close()
+        tm.stop()
+
+    def test_kv_ckpt_plan_rehydrate(self, tmp_path):
+        from dlrover_tpu.master.elastic_training.rescale_coordinator import (
+            RescaleCoordinator,
+        )
+
+        path = str(tmp_path / "m.journal")
+        j = MasterJournal(path)
+        j.append("kv_set", key="k", val="dg==")
+        j.append("ckpt_step", step=123)
+        j.append("plan_cut", plan_id=5)
+        j.close()
+        j2 = MasterJournal(path)
+        kv = KVStoreService()
+        coord = RescaleCoordinator()
+        restore_master_state(
+            j2.recovered, kv_store=kv, rescale_coordinator=coord
+        )
+        assert kv.get("k") == b"v"
+        # A restarted master never re-issues a stale plan_id and never
+        # forgets the newest committed step.
+        assert coord._plan_seq == 5  # noqa: SLF001
+        assert coord._committed_step == 123  # noqa: SLF001
+        j2.close()
+
+    def test_journal_dump_tool(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "journal_dump",
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "journal_dump.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        path = str(tmp_path / "m.journal")
+        _journal_with_leases(path).close()
+        out = mod.dump(path, with_datasets=True)
+        assert out["clean_shutdown"]
+        assert out["kinds"]["dispatch"] == 3
+        assert out["tail"]["torn"] is False
+        assert out["datasets"]["ds"]["outstanding_leases"] == [1, 2]
+        assert mod.main([path, "--validate"]) == 0
+
+
+class _LiveMaster:
+    """In-process journaled master over the real HTTP transport."""
+
+    def __init__(self, journal_path, port=0):
+        self.journal = MasterJournal(journal_path)
+        self.task_manager = TaskManager(task_timeout=600.0)
+        self.kv_store = KVStoreService()
+        restore_master_state(
+            self.journal.recovered, task_manager=self.task_manager,
+            kv_store=self.kv_store,
+        )
+        self.servicer = MasterServicer(
+            rdzv_managers={}, task_manager=self.task_manager,
+            perf_monitor=PerfMonitor(), sync_service=SyncService(),
+            kv_store=self.kv_store, journal=self.journal,
+        )
+        self.server = HttpMasterServer(port, self.servicer)
+        self.server.add_shutdown_hook(self.journal.close)
+        self.server.start()
+        self.port = self.server.port
+
+    def stop(self, graceful=False):
+        if graceful:
+            self.server.graceful_stop(drain_s=2.0)
+        else:
+            self.server.stop()
+        self.task_manager.stop()
+        if not self.journal.closed:
+            self.journal.close()
+
+
+class TestEpochFencingAndRideThrough:
+    def test_epoch_stamped_and_listener_fires_on_restart(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        m1 = _LiveMaster(path)
+        client = MasterClient(
+            f"localhost:{m1.port}", node_id=0, kind="http", timeout=10.0
+        )
+        changes = []
+        client.add_epoch_listener(lambda old, new: changes.append((old, new)))
+        try:
+            client.kv_store_set("k", b"v")
+            assert client.master_epoch == 1
+            assert changes == []  # first observation only records
+            m1.stop(graceful=True)
+
+            m2 = _LiveMaster(path, port=m1.port)
+            try:
+                # Restored kv survives, and the bumped epoch is fenced
+                # into the reply, firing the change listener exactly once.
+                assert client.kv_store_get("k") == b"v"
+                assert client.master_epoch == 2
+                assert changes == [(1, 2)]
+            finally:
+                m2.stop()
+        finally:
+            client.close()
+
+    def test_outage_ride_through(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(OUTAGE_ENV, "15")
+        path = str(tmp_path / "m.journal")
+        m1 = _LiveMaster(path)
+        client = MasterClient(
+            f"localhost:{m1.port}", node_id=0, kind="http", timeout=10.0
+        )
+        try:
+            client.kv_store_set("k", b"v1")
+            port = m1.port
+            m1.stop(graceful=True)
+            restarted = {}
+
+            def restart():
+                time.sleep(1.0)
+                restarted["m"] = _LiveMaster(path, port=port)
+
+            t = threading.Thread(target=restart, daemon=True)
+            t.start()
+            # The call spans the outage: refused while the master is
+            # down, then rides through to the restarted generation.
+            t0 = time.monotonic()
+            assert client.kv_store_get("k") == b"v1"
+            assert time.monotonic() - t0 >= 0.5
+            assert not client.in_outage
+            t.join()
+            restarted["m"].stop()
+        finally:
+            client.close()
+
+    def test_retries_exhausted_names_verb_and_counts(self, monkeypatch):
+        monkeypatch.setenv(MAX_RETRIES_ENV, "2")
+        monkeypatch.delenv(OUTAGE_ENV, raising=False)
+        # A port with nothing listening: connection refused every time.
+        import socket
+
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        client = MasterClient(
+            f"localhost:{dead_port}", node_id=0, kind="http", timeout=2.0
+        )
+        counter = default_registry().get("client_rpc_retries_exhausted_total")
+        before = counter.value(verb="kv_store_get") if counter else 0.0
+        try:
+            with pytest.raises(RpcRetriesExhausted) as exc:
+                client.kv_store_get("k")
+            assert exc.value.verb == "kv_store_get"
+            assert exc.value.attempts == 2
+            assert "kv_store_get" in str(exc.value)
+            counter = default_registry().get(
+                "client_rpc_retries_exhausted_total"
+            )
+            assert counter.value(verb="kv_store_get") == before + 1
+        finally:
+            client.close()
+
+    def test_graceful_stop_flushes_clean_shutdown(self, tmp_path):
+        path = str(tmp_path / "m.journal")
+        m = _LiveMaster(path)
+        client = MasterClient(
+            f"localhost:{m.port}", node_id=0, kind="http", timeout=10.0
+        )
+        try:
+            client.report_ckpt_step(10, committed=True)
+        finally:
+            client.close()
+        m.stop(graceful=True)
+        st = load_journal(path)
+        assert st.clean_shutdown
+        assert st.ckpt_step == 10

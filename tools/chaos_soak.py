@@ -1,12 +1,13 @@
 """Seeded chaos-soak CLI: drive the whole stack through reproducible
 fault episodes and assert the five system invariants.
 
-    python tools/chaos_soak.py --seed 0 --episodes 7
+    python tools/chaos_soak.py --seed 0 --episodes 8
     python tools/chaos_soak.py --seed 0 --episode 1      # repro one
     python tools/chaos_soak.py --seed 0 --episode 3      # rescale kill
     python tools/chaos_soak.py --seed 0 --episode 4      # fleet reroute
     python tools/chaos_soak.py --seed 0 --episode 5      # autoscaler A/B
     python tools/chaos_soak.py --seed 0 --episode 6      # migration kill
+    python tools/chaos_soak.py --seed 0 --episode 7      # master kill
 
 Each episode runs an in-process master, worker subprocesses and a
 serving engine under a deterministic seeded fault schedule (worker
@@ -26,7 +27,13 @@ the closed-loop autoscaler episode
 schedule (persistent per-rank delay at the step fault point, worker
 deaths, a serving spike) run static, dry-run and autoscaled — the
 autoscaled run must evict the straggler within bounded decision
-windows and strictly beat the static goodput fraction. The
+windows and strictly beat the static goodput fraction. Episode 7 is
+the control-plane crash episode
+(``dlrover_tpu/testing/master_kill_soak.py``): the MASTER subprocess
+is SIGKILLed between a journaled shard dispatch and its reply,
+restarted from its durable journal (DESIGN.md §37), and the
+never-restarted worker must ride the outage out and finish with
+exactly-once accounting. The
 implementation and the invariant definitions live in
 ``dlrover_tpu/testing/soak.py`` (docs/DESIGN.md §26-§30); exit code 0
 means every episode held every invariant. Prints one JSON summary line
@@ -52,11 +59,12 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="seeded chaos soak")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--episodes", type=int, default=7,
-        help="episode count; 7 covers the full fault matrix incl. "
+        "--episodes", type=int, default=8,
+        help="episode count; 8 covers the full fault matrix incl. "
         "kill_during_rescale, replica_kill_reroute, the "
-        "straggler_evict autoscaler A/B and the §36 "
-        "kill_during_migration destination SIGKILL",
+        "straggler_evict autoscaler A/B, the §36 "
+        "kill_during_migration destination SIGKILL and the §37 "
+        "master_kill control-plane crash",
     )
     parser.add_argument(
         "--episode", type=int, default=None,
